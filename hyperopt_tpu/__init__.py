@@ -1,0 +1,13 @@
+"""hyperopt_tpu — a TPU-native hyperparameter-optimization framework.
+
+Brand-new implementation of the capabilities of hyperopt (reference:
+gsmafra/hyperopt; see SURVEY.md): the ``hp.*`` conditional search-space DSL,
+the ``fmin`` driver, the ``Trials`` store abstraction, and the algorithm
+suite (``rand``, ``anneal``, ``tpe``, ``atpe``, ``mix``) — with the numeric
+core (space sampling, TPE adaptive-Parzen fit + log-EI scoring) compiled to
+XLA via JAX and sharded across TPU meshes.
+"""
+
+from . import pyll
+
+__version__ = "0.1.0"

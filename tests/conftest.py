@@ -1,0 +1,15 @@
+"""Test configuration.
+
+Runs the suite on a virtual 8-device CPU mesh (the prescribed way to test
+TPU sharding logic without a pod); must set env vars before jax initializes.
+Benchmarks (bench.py) run separately on the real TPU chip.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,115 @@
+"""Statistical tests of the stochastic scope symbols + sample().
+
+Mirrors the reference's sample-histogram style checks (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu.pyll import as_apply, rec_eval, sample, scope
+from hyperopt_tpu.pyll.stochastic import recursive_set_rng_kwarg
+
+
+RNG = lambda: np.random.default_rng(42)
+
+
+def test_sample_uniform_range():
+    draws = np.array([sample(scope.uniform(-2.0, 3.0), RNG()) for _ in range(1)])
+    big = sample(scope.uniform(-2.0, 3.0, size=(10000,)), RNG())
+    assert big.shape == (10000,)
+    assert big.min() >= -2.0 and big.max() < 3.0
+    assert abs(big.mean() - 0.5) < 0.1
+
+
+def test_sample_loguniform_support():
+    big = sample(scope.loguniform(np.log(1e-3), np.log(1e2), size=(5000,)), RNG())
+    assert big.min() >= 1e-3 and big.max() <= 1e2
+    # log of draws should be uniform
+    logs = np.log(big)
+    assert abs(logs.mean() - (np.log(1e-3) + np.log(1e2)) / 2) < 0.2
+
+
+def test_sample_quniform_grid():
+    big = sample(scope.quniform(0.0, 10.0, 0.5, size=(2000,)), RNG())
+    assert np.allclose(np.round(big / 0.5) * 0.5, big)
+
+
+def test_sample_qloguniform_grid():
+    big = sample(scope.qloguniform(np.log(1.0), np.log(100.0), 2.0, size=(2000,)), RNG())
+    assert np.allclose(np.round(big / 2.0) * 2.0, big)
+    assert big.min() >= 0.0
+
+
+def test_sample_normal_moments():
+    big = sample(scope.normal(5.0, 2.0, size=(20000,)), RNG())
+    assert abs(big.mean() - 5.0) < 0.1
+    assert abs(big.std() - 2.0) < 0.1
+
+
+def test_sample_qnormal_grid():
+    big = sample(scope.qnormal(0.0, 3.0, 1.0, size=(2000,)), RNG())
+    assert np.allclose(np.round(big), big)
+
+
+def test_sample_lognormal_positive():
+    big = sample(scope.lognormal(0.0, 1.0, size=(5000,)), RNG())
+    assert big.min() > 0
+    assert abs(np.log(big).mean()) < 0.1
+
+
+def test_sample_qlognormal():
+    big = sample(scope.qlognormal(2.0, 1.0, 1.0, size=(2000,)), RNG())
+    assert np.allclose(np.round(big), big)
+    assert big.min() >= 0.0
+
+
+def test_sample_randint_range():
+    big = sample(scope.randint(7, size=(5000,)), RNG())
+    assert set(np.unique(big)) <= set(range(7))
+    # roughly uniform
+    counts = np.bincount(big, minlength=7)
+    assert counts.min() > 5000 / 7 * 0.7
+
+
+def test_sample_categorical_probs():
+    p = [0.1, 0.6, 0.3]
+    big = sample(scope.categorical(p, size=(5000,)), RNG())
+    freq = np.bincount(big, minlength=3) / 5000
+    assert np.allclose(freq, p, atol=0.05)
+
+
+def test_sample_nested_space():
+    space = {"a": scope.uniform(0.0, 1.0), "b": [scope.normal(0.0, 1.0), 3]}
+    s = sample(space, RNG())
+    assert set(s.keys()) == {"a", "b"}
+    assert 0 <= s["a"] < 1
+    assert s["b"][1] == 3
+
+
+def test_sample_is_seeded_deterministic():
+    space = {"a": scope.uniform(0.0, 1.0), "b": scope.randint(10)}
+    s1 = sample(space, np.random.default_rng(7))
+    s2 = sample(space, np.random.default_rng(7))
+    assert s1 == s2
+
+
+def test_sample_does_not_mutate_space():
+    node = scope.uniform(0.0, 1.0)
+    sample(node, RNG())
+    # original node must not have acquired an rng kwarg
+    assert not any(k == "rng" for k, _ in node.named_args)
+
+
+def test_stochastic_without_rng_raises():
+    node = scope.uniform(0.0, 1.0)
+    with pytest.raises(ValueError):
+        rec_eval(node)
+
+
+def test_recursive_set_rng_kwarg_in_place():
+    node = scope.normal(0.0, 1.0)
+    expr = scope.add(node, as_apply(1.0))
+    recursive_set_rng_kwarg(expr, np.random.default_rng(0))
+    assert any(k == "rng" for k, _ in node.named_args)
+    val = rec_eval(expr)
+    assert np.isfinite(val)
