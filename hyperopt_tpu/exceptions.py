@@ -1,0 +1,46 @@
+"""Framework exceptions.
+
+Reference parity (SURVEY.md §2 #13): ``hyperopt/exceptions.py`` —
+``AllTrialsFailed``, ``InvalidTrial``, ``InvalidResultStatus``,
+``InvalidLoss``, ``DuplicateLabel``.
+"""
+
+
+class BadSearchSpace(Exception):
+    """The search space is malformed."""
+
+
+class DuplicateLabel(BadSearchSpace):
+    """The same hyperparameter label is used by two distinct nodes."""
+
+
+class InvalidTrial(ValueError):
+    """A trial document does not have the required structure."""
+
+    def __init__(self, msg, trial):
+        super().__init__(msg, trial)
+        self.trial = trial
+
+
+class InvalidResultStatus(ValueError):
+    """An objective returned a result dict with an invalid status."""
+
+    def __init__(self, result):
+        super().__init__(result)
+        self.result = result
+
+
+class InvalidLoss(ValueError):
+    """An objective returned a non-finite or non-numeric loss."""
+
+    def __init__(self, result):
+        super().__init__(result)
+        self.result = result
+
+
+class AllTrialsFailed(Exception):
+    """Every trial errored or failed; there is no argmin."""
+
+
+class InvalidAnnotatedParameter(ValueError):
+    """fn has a parameter with an unsupported annotation."""

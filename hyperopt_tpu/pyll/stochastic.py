@@ -98,8 +98,12 @@ def qlognormal(mu, sigma, q, rng=None, size=()):
 
 @implicit_stochastic
 @scope.define
-def randint(upper, rng=None, size=()):
-    return _rng(rng).integers(0, upper, size=size)
+def randint(low, high=None, rng=None, size=()):
+    """``randint(upper)`` draws from [0, upper); ``randint(low, high)``
+    from [low, high) — both reference DSL forms."""
+    if high is None:
+        low, high = 0, low
+    return _rng(rng).integers(low, high, size=size)
 
 
 @implicit_stochastic

@@ -1,0 +1,165 @@
+"""Tests for the hp DSL + expr_to_config conditionality extraction.
+
+Mirrors the reference's test_pyll_utils.py (SURVEY.md §4): expected
+conditions per label; DuplicateLabel raises.
+"""
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.exceptions import DuplicateLabel
+from hyperopt_tpu.pyll import sample, scope
+from hyperopt_tpu.pyll_utils import EQ, Cond, expr_to_config
+
+
+RNG = lambda s=0: np.random.default_rng(s)
+
+
+def test_hp_uniform_structure():
+    node = hp.uniform("x", -1.0, 1.0)
+    assert node.name == "float"
+    hparam = node.pos_args[0]
+    assert hparam.name == "hyperopt_param"
+    assert hparam.pos_args[0].obj == "x"
+    assert hparam.pos_args[1].name == "uniform"
+
+
+def test_hp_choice_structure():
+    node = hp.choice("c", ["a", "b", "c"])
+    assert node.name == "switch"
+    idx = node.pos_args[0]
+    assert idx.name == "hyperopt_param"
+    assert idx.pos_args[1].name == "randint"
+
+
+@pytest.mark.parametrize(
+    "make,check",
+    [
+        (lambda: hp.uniform("x", 0, 1), lambda v: 0 <= v < 1),
+        (lambda: hp.quniform("x", 0, 10, 2), lambda v: v % 2 == 0),
+        (lambda: hp.uniformint("x", 0, 10), lambda v: isinstance(v, int)),
+        (lambda: hp.loguniform("x", np.log(1e-3), np.log(1e3)), lambda v: 1e-3 <= v <= 1e3),
+        (lambda: hp.qloguniform("x", np.log(1), np.log(100), 5), lambda v: v % 5 == 0),
+        (lambda: hp.normal("x", 0, 1), lambda v: isinstance(v, float)),
+        (lambda: hp.qnormal("x", 0, 5, 1), lambda v: v == round(v)),
+        (lambda: hp.lognormal("x", 0, 1), lambda v: v > 0),
+        (lambda: hp.qlognormal("x", 0, 1, 1), lambda v: v >= 0 and v == round(v)),
+        (lambda: hp.randint("x", 5), lambda v: 0 <= v < 5),
+        (lambda: hp.randint("x", 3, 8), lambda v: 3 <= v < 8),
+        (lambda: hp.choice("x", [10, 20]), lambda v: v in (10, 20)),
+        (lambda: hp.pchoice("x", [(0.3, "a"), (0.7, "b")]), lambda v: v in ("a", "b")),
+    ],
+)
+def test_hp_dists_sample_in_support(make, check):
+    node = make()
+    for seed in range(20):
+        assert check(sample(node, RNG(seed)))
+
+
+def test_label_must_be_string():
+    with pytest.raises(TypeError):
+        hp.uniform(3, 0, 1)
+    with pytest.raises(TypeError):
+        hp.choice(None, [1, 2])
+
+
+def test_pchoice_probs_must_sum_to_one():
+    with pytest.raises(ValueError):
+        hp.pchoice("p", [(0.5, "a"), (0.1, "b")])
+
+
+def test_choice_rejects_dict():
+    with pytest.raises(TypeError):
+        hp.choice("c", {"a": 1})
+
+
+def test_expr_to_config_flat():
+    space = {"x": hp.uniform("x", 0, 1), "y": hp.randint("y", 4)}
+    hps = {}
+    expr_to_config(space, (), hps)
+    assert set(hps) == {"x", "y"}
+    assert hps["x"]["conditions"] == {()}
+    assert hps["x"]["node"].name == "uniform"
+    assert hps["y"]["node"].name == "randint"
+
+
+def test_expr_to_config_conditional():
+    space = hp.choice(
+        "root",
+        [
+            {"kind": "svm", "C": hp.lognormal("C", 0, 1)},
+            {"kind": "dtree", "depth": hp.randint("depth", 10)},
+        ],
+    )
+    hps = {}
+    expr_to_config(space, (), hps)
+    assert set(hps) == {"root", "C", "depth"}
+    assert hps["root"]["conditions"] == {()}
+    assert hps["C"]["conditions"] == {(EQ("root", 0),)}
+    assert hps["depth"]["conditions"] == {(EQ("root", 1),)}
+
+
+def test_expr_to_config_nested_conditions():
+    inner = hp.choice("inner", [hp.uniform("a", 0, 1), hp.uniform("b", 0, 1)])
+    space = hp.choice("outer", [inner, {"c": hp.uniform("c", 0, 1)}])
+    hps = {}
+    expr_to_config(space, (), hps)
+    assert hps["a"]["conditions"] == {(EQ("outer", 0), EQ("inner", 0))}
+    assert hps["b"]["conditions"] == {(EQ("outer", 0), EQ("inner", 1))}
+    assert hps["c"]["conditions"] == {(EQ("outer", 1),)}
+    assert hps["inner"]["conditions"] == {(EQ("outer", 0),)}
+
+
+def test_expr_to_config_shared_param_across_branches():
+    shared = hp.uniform("lr", 0, 1)
+    space = hp.choice("m", [{"lr": shared}, {"lr": shared, "extra": hp.uniform("e", 0, 1)}])
+    hps = {}
+    expr_to_config(space, (), hps)
+    # same node under both branches -> two conjunctions, no DuplicateLabel
+    assert hps["lr"]["conditions"] == {(EQ("m", 0),), (EQ("m", 1),)}
+
+
+def test_duplicate_label_raises():
+    space = {"a": hp.uniform("x", 0, 1), "b": hp.uniform("x", 0, 1)}
+    hps = {}
+    with pytest.raises(DuplicateLabel):
+        expr_to_config(space, (), hps)
+
+
+def test_unconditional_shadows_conditional():
+    shared = hp.uniform("u", 0, 1)
+    space = {"always": shared, "maybe": hp.choice("c", [shared, 0])}
+    hps = {}
+    expr_to_config(space, (), hps)
+    assert hps["u"]["conditions"] == {()}
+
+
+def test_cond_eval():
+    c = EQ("x", 2)
+    assert c({"x": 2})
+    assert not c({"x": 3})
+    assert not c({"x": None})
+    with pytest.raises(KeyError):
+        c({})
+    assert Cond("y", 5, ">")({"y": 7})
+    assert Cond("y", 5, "<")({"y": 3})
+
+
+def test_conditional_sampling_end_to_end():
+    space = hp.choice(
+        "algo",
+        [
+            {"name": "sgd", "lr": hp.loguniform("lr", -5, 0)},
+            {"name": "adam", "beta": hp.uniform("beta", 0.8, 1.0)},
+        ],
+    )
+    seen = set()
+    for seed in range(30):
+        s = sample(space, RNG(seed))
+        seen.add(s["name"])
+        if s["name"] == "sgd":
+            assert "lr" in s and "beta" not in s
+        else:
+            assert "beta" in s and "lr" not in s
+    assert seen == {"sgd", "adam"}
