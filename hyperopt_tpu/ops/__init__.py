@@ -1,0 +1,5 @@
+"""TPU numeric kernels: distribution samplers, Parzen fits, GMM scoring.
+
+Everything in this package is JAX: pure functions over arrays, designed to
+be jitted/vmapped/shard_mapped.  Host-side orchestration lives elsewhere.
+"""

@@ -1,0 +1,95 @@
+"""JAX samplers for the search-space DSL distributions.
+
+The numpy implementations in ``hyperopt_tpu.pyll.stochastic`` define the
+semantics (support + quantization rule); these are the XLA lowerings the
+compiled sampler uses — same distributions, ``jax.random`` key-splitting
+instead of a shared mutable rng (reference:
+``hyperopt/pyll/stochastic.py`` ~L20-130).
+
+Every sampler has signature ``f(key, params: dict, n: int) -> jnp.ndarray``
+with static ``params``/``n`` so a whole-space sampler jits into one fused
+program.  Quantization matches the reference rule ``round(x / q) * q``
+(round-half-to-even, numpy semantics) exactly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_FLOAT = jnp.float32
+_INT = jnp.int32
+
+
+def _quantize(x, q):
+    # jnp.round is round-half-to-even, matching np.round in the reference
+    return jnp.round(x / q) * q
+
+
+def uniform(key, p, n):
+    return jax.random.uniform(
+        key, (n,), dtype=_FLOAT, minval=p["low"], maxval=p["high"]
+    )
+
+
+def quniform(key, p, n):
+    return _quantize(uniform(key, p, n), p["q"])
+
+
+def loguniform(key, p, n):
+    return jnp.exp(uniform(key, p, n))
+
+
+def qloguniform(key, p, n):
+    return _quantize(loguniform(key, p, n), p["q"])
+
+
+def uniformint(key, p, n):
+    # reference semantics: round(uniform(low, high) / q) * q, as integer —
+    # endpoints get half weight (NOT the same as randint(low, high))
+    return _quantize(uniform(key, p, n), p.get("q", 1.0)).astype(_INT)
+
+
+def normal(key, p, n):
+    return p["mu"] + p["sigma"] * jax.random.normal(key, (n,), dtype=_FLOAT)
+
+
+def qnormal(key, p, n):
+    return _quantize(normal(key, p, n), p["q"])
+
+
+def lognormal(key, p, n):
+    return jnp.exp(normal(key, p, n))
+
+
+def qlognormal(key, p, n):
+    return _quantize(lognormal(key, p, n), p["q"])
+
+
+def randint(key, p, n):
+    low = p.get("low", 0)
+    high = p["high"]
+    return jax.random.randint(key, (n,), low, high, dtype=_INT)
+
+
+def categorical(key, p, n):
+    logits = jnp.log(jnp.asarray(p["p"], dtype=_FLOAT))
+    return jax.random.categorical(key, logits, shape=(n,)).astype(_INT)
+
+
+SAMPLERS = {
+    "uniform": uniform,
+    "quniform": quniform,
+    "loguniform": loguniform,
+    "qloguniform": qloguniform,
+    "uniformint": uniformint,
+    "normal": normal,
+    "qnormal": qnormal,
+    "lognormal": lognormal,
+    "qlognormal": qlognormal,
+    "randint": randint,
+    "categorical": categorical,
+}
+
+# distributions whose values are integer-valued indices/counts
+INT_DISTS = {"uniformint", "randint", "categorical"}

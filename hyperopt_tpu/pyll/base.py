@@ -434,6 +434,7 @@ def rec_eval(
     max_program_len=100000,
     memo_gc=True,
     print_node_on_error=True,
+    return_memo=False,
 ):
     """Evaluate the graph iteratively (no Python recursion limit).
 
@@ -499,6 +500,8 @@ def rec_eval(
                 print("=" * 60)
             raise
         todo.pop()
+    if return_memo:
+        return memo[node], memo
     return memo[node]
 
 

@@ -1,0 +1,178 @@
+"""Tests of the space compiler (reference parity: test_vectorize.py).
+
+Checks compiled-vs-interpreted distribution agreement, activity masks under
+conditionality, determinism, and the idxs/vals sparse data model.
+"""
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.pyll import as_apply, scope
+from hyperopt_tpu.vectorize import CompiledSpace, idxs_vals_from_batch
+
+
+def test_compiles_flat_space():
+    space = {
+        "lr": hp.loguniform("lr", np.log(1e-5), np.log(1e-1)),
+        "n": hp.randint("n", 8),
+        "m": hp.quniform("m", 0, 100, 10),
+    }
+    cs = CompiledSpace(space)
+    assert cs.compiled
+    assert set(cs.labels) == {"lr", "n", "m"}
+    vals, active = cs.sample_batch(0, 500)
+    assert vals["lr"].shape == (500,)
+    assert np.all((vals["lr"] >= 1e-5) & (vals["lr"] <= 1e-1 + 1e-6))
+    assert np.all((vals["n"] >= 0) & (vals["n"] < 8))
+    assert np.allclose(np.round(vals["m"] / 10) * 10, vals["m"])
+    assert all(active[lb].all() for lb in cs.labels)
+
+
+def test_all_dists_compile_and_sample():
+    space = {
+        "u": hp.uniform("u", -1, 1),
+        "qu": hp.quniform("qu", 0, 10, 0.5),
+        "ui": hp.uniformint("ui", 0, 5),
+        "lu": hp.loguniform("lu", 0, 2),
+        "qlu": hp.qloguniform("qlu", 0, 3, 1),
+        "n": hp.normal("n", 3, 2),
+        "qn": hp.qnormal("qn", 0, 2, 1),
+        "ln": hp.lognormal("ln", 0, 1),
+        "qln": hp.qlognormal("qln", 0, 1, 1),
+        "ri": hp.randint("ri", 2, 9),
+        "c": hp.pchoice("c", [(0.2, "a"), (0.8, "b")]),
+    }
+    cs = CompiledSpace(space)
+    assert cs.compiled, cs.compile_error
+    vals, active = cs.sample_batch(1, 1000)
+    assert np.all((vals["u"] >= -1) & (vals["u"] < 1))
+    assert np.all(vals["ui"] >= 0) and np.all(vals["ui"] <= 5)
+    assert np.all(vals["ri"] >= 2) and np.all(vals["ri"] < 9)
+    assert np.all((vals["c"] == 0) | (vals["c"] == 1))
+    assert abs(np.mean(vals["c"]) - 0.8) < 0.05
+    assert np.all(vals["ln"] > 0)
+    assert np.allclose(np.round(vals["qn"]), vals["qn"])
+
+
+def test_determinism_same_seed():
+    space = {"x": hp.uniform("x", 0, 1), "k": hp.randint("k", 5)}
+    cs = CompiledSpace(space)
+    v1, _ = cs.sample_batch(42, 10)
+    v2, _ = cs.sample_batch(42, 10)
+    assert np.array_equal(v1["x"], v2["x"])
+    assert np.array_equal(v1["k"], v2["k"])
+    v3, _ = cs.sample_batch(43, 10)
+    assert not np.array_equal(v1["x"], v3["x"])
+
+
+def test_conditional_activity_masks():
+    space = hp.choice(
+        "model",
+        [
+            {"kind": "svm", "C": hp.loguniform("C", -3, 3)},
+            {"kind": "rf", "depth": hp.randint("depth", 10)},
+        ],
+    )
+    cs = CompiledSpace(space)
+    assert cs.compiled
+    vals, active = cs.sample_batch(7, 2000)
+    choice = vals["model"]
+    assert np.array_equal(active["C"], choice == 0)
+    assert np.array_equal(active["depth"], choice == 1)
+    assert active["model"].all()
+    # both branches exercised
+    assert 0.3 < choice.mean() < 0.7
+
+
+def test_nested_conditional_activity():
+    inner = hp.choice("inner", [{"a": hp.uniform("a", 0, 1)}, {"b": hp.uniform("b", 0, 1)}])
+    space = hp.choice("outer", [inner, {"c": hp.uniform("c", 0, 1)}])
+    cs = CompiledSpace(space)
+    vals, active = cs.sample_batch(3, 2000)
+    outer, inner_v = vals["outer"], vals["inner"]
+    np.testing.assert_array_equal(active["a"], (outer == 0) & (inner_v == 0))
+    np.testing.assert_array_equal(active["b"], (outer == 0) & (inner_v == 1))
+    np.testing.assert_array_equal(active["c"], outer == 1)
+    np.testing.assert_array_equal(active["inner"], outer == 0)
+
+
+def test_compiled_matches_interpreted_statistically():
+    """Same distributions through the jitted path and the rec_eval path."""
+    space = {
+        "n": hp.normal("n", 2.0, 3.0),
+        "lu": hp.loguniform("lu", np.log(0.1), np.log(10.0)),
+    }
+    cs = CompiledSpace(space)
+    assert cs.compiled
+    cvals, _ = cs.sample_batch(0, 8000)
+    # force the interpreted path on a copy
+    cs2 = CompiledSpace(space)
+    ivals, _ = cs2._sample_interpreted(0, 2000)
+    assert abs(cvals["n"].mean() - ivals["n"].mean()) < 0.25
+    assert abs(cvals["n"].std() - ivals["n"].std()) < 0.25
+    assert abs(np.log(cvals["lu"]).mean() - np.log(ivals["lu"]).mean()) < 0.15
+
+
+def test_uncompilable_space_falls_back():
+    # non-literal distribution parameter -> interpreted path
+    high = as_apply(1.0) + 1.0
+    space = {"x": scope.float(scope.hyperopt_param("x", scope.uniform(0.0, high)))}
+    cs = CompiledSpace(space)
+    assert not cs.compiled
+    vals, active = cs.sample_batch(0, 50)
+    assert np.all((vals["x"] >= 0) & (vals["x"] < 2.0))
+    assert active["x"].all()
+
+
+def test_interpreted_fallback_conditionals():
+    high = as_apply(1.0) + 0.0  # defeat compilation
+    space = hp.choice(
+        "c",
+        [
+            {"x": scope.float(scope.hyperopt_param("x", scope.uniform(0.0, high)))},
+            {"y": hp.uniform("y", 0, 1)},
+        ],
+    )
+    cs = CompiledSpace(space)
+    assert not cs.compiled
+    vals, active = cs.sample_batch(0, 100)
+    # activity from lazy evaluation: exactly one branch active per draw
+    assert np.array_equal(active["x"], ~active["y"])
+
+
+def test_idxs_vals_from_batch():
+    space = hp.choice("c", [{"x": hp.uniform("x", 0, 1)}, {"k": hp.randint("k", 3)}])
+    cs = CompiledSpace(space)
+    vals, active = cs.sample_batch(0, 6)
+    tids = [10, 11, 12, 13, 14, 15]
+    idxs, vv = idxs_vals_from_batch(tids, vals, active, cs.specs)
+    assert idxs["c"] == tids
+    assert len(idxs["x"]) + len(idxs["k"]) == 6
+    for t, v in zip(idxs["x"], vv["x"]):
+        assert isinstance(t, int) and isinstance(v, float)
+    for t, v in zip(idxs["k"], vv["k"]):
+        assert isinstance(v, int)
+
+
+def test_param_spec_upper():
+    space = {
+        "r": hp.randint("r", 3, 9),
+        "c": hp.pchoice("c", [(0.5, 0), (0.5, 1)]),
+        "u": hp.uniform("u", 0, 1),
+    }
+    cs = CompiledSpace(space)
+    assert cs.specs["r"].upper == 6
+    assert cs.specs["c"].upper == 2
+    assert cs.specs["u"].upper is None
+
+
+def test_device_sample_batch_returns_jax_arrays():
+    import jax.numpy as jnp
+    import jax
+
+    space = {"x": hp.uniform("x", 0, 1)}
+    cs = CompiledSpace(space)
+    vals, active = cs.device_sample_batch(jax.random.PRNGKey(0), 16)
+    assert isinstance(vals["x"], jnp.ndarray)
+    assert vals["x"].shape == (16,)
