@@ -1,0 +1,326 @@
+"""Core runtime tests (reference parity: hyperopt/tests/test_base.py):
+Trials bookkeeping, Domain.evaluate, SONify, exception paths.
+"""
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.base import (
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    STATUS_FAIL,
+    STATUS_OK,
+    Ctrl,
+    Domain,
+    SONify,
+    Trials,
+    miscs_to_idxs_vals,
+    miscs_update_idxs_vals,
+    spec_from_misc,
+    trials_from_docs,
+    validate_loss_threshold,
+    validate_timeout,
+)
+from hyperopt_tpu.exceptions import (
+    AllTrialsFailed,
+    InvalidLoss,
+    InvalidResultStatus,
+    InvalidTrial,
+)
+
+
+def make_trial(tid, loss=None, state=JOB_STATE_DONE, status=STATUS_OK, exp_key=None,
+               labels=("x",), vals=(0.5,)):
+    misc = {
+        "tid": tid,
+        "cmd": ("domain_attachment", "FMinIter_Domain"),
+        "idxs": {lb: [tid] for lb in labels},
+        "vals": {lb: [v] for lb, v in zip(labels, vals)},
+    }
+    result = {"status": status}
+    if loss is not None:
+        result["loss"] = loss
+    return {
+        "tid": tid,
+        "spec": None,
+        "result": result,
+        "misc": misc,
+        "state": state,
+        "owner": None,
+        "book_time": None,
+        "refresh_time": None,
+        "exp_key": exp_key,
+    }
+
+
+class TestSONify:
+    def test_numpy_scalars(self):
+        assert SONify(np.float64(1.5)) == 1.5
+        assert type(SONify(np.float64(1.5))) is float
+        assert SONify(np.int32(3)) == 3
+        assert type(SONify(np.int32(3))) is int
+        assert SONify(np.bool_(True)) is True
+
+    def test_arrays_and_containers(self):
+        assert SONify(np.array([1, 2, 3])) == [1, 2, 3]
+        assert SONify(np.array(2.0)) == 2.0
+        assert SONify({"a": np.int64(1), "b": (np.float32(0.5),)}) == {
+            "a": 1,
+            "b": (0.5,),
+        }
+
+    def test_unserializable_raises(self):
+        with pytest.raises(TypeError):
+            SONify(object())
+
+
+class TestTrials:
+    def test_new_trial_ids_monotonic(self):
+        t = Trials()
+        assert t.new_trial_ids(3) == [0, 1, 2]
+        assert t.new_trial_ids(2) == [3, 4]
+
+    def test_insert_and_refresh(self):
+        t = Trials()
+        docs = [make_trial(0, loss=1.0), make_trial(1, loss=0.5)]
+        t.insert_trial_docs(docs)
+        t.refresh()
+        assert len(t) == 2
+        assert t.losses() == [1.0, 0.5]
+        assert t.statuses() == [STATUS_OK, STATUS_OK]
+
+    def test_error_trials_filtered(self):
+        t = Trials()
+        t.insert_trial_docs([make_trial(0, loss=1.0), make_trial(1, state=JOB_STATE_ERROR)])
+        t.refresh()
+        assert len(t) == 1
+
+    def test_best_trial_and_argmin(self):
+        t = Trials()
+        t.insert_trial_docs(
+            [
+                make_trial(0, loss=3.0, vals=(0.1,)),
+                make_trial(1, loss=1.0, vals=(0.7,)),
+                make_trial(2, loss=2.0, vals=(0.9,)),
+            ]
+        )
+        t.refresh()
+        assert t.best_trial["tid"] == 1
+        assert t.argmin == {"x": 0.7}
+
+    def test_all_trials_failed(self):
+        t = Trials()
+        t.insert_trial_docs([make_trial(0, status=STATUS_FAIL)])
+        t.refresh()
+        with pytest.raises(AllTrialsFailed):
+            t.best_trial
+
+    def test_exp_key_filtering(self):
+        t = Trials(exp_key="mine")
+        t._insert_trial_docs(
+            [make_trial(0, loss=1.0, exp_key="mine"), make_trial(1, loss=0.1, exp_key="other")]
+        )
+        t.refresh()
+        assert len(t) == 1
+        assert t.best_trial["tid"] == 0
+
+    def test_insert_wrong_exp_key_raises(self):
+        t = Trials(exp_key="mine")
+        with pytest.raises(InvalidTrial):
+            t.insert_trial_doc(make_trial(0, loss=1.0, exp_key="other"))
+
+    def test_invalid_trial_missing_key(self):
+        t = Trials()
+        doc = make_trial(0, loss=1.0)
+        del doc["misc"]["cmd"]
+        with pytest.raises(InvalidTrial):
+            t.insert_trial_doc(doc)
+
+    def test_tid_mismatch_raises(self):
+        t = Trials()
+        doc = make_trial(0, loss=1.0)
+        doc["misc"]["tid"] = 5
+        with pytest.raises(InvalidTrial):
+            t.insert_trial_doc(doc)
+
+    def test_count_by_state(self):
+        t = Trials()
+        t.insert_trial_docs(
+            [make_trial(0, loss=1.0), make_trial(1, state=JOB_STATE_NEW, status="new")]
+        )
+        t.refresh()
+        assert t.count_by_state_synced(JOB_STATE_DONE) == 1
+        assert t.count_by_state_unsynced(JOB_STATE_NEW) == 1
+        assert t.count_by_state_synced((JOB_STATE_NEW, JOB_STATE_DONE)) == 2
+
+    def test_attachments(self):
+        t = Trials()
+        t.insert_trial_docs([make_trial(0, loss=1.0)])
+        t.refresh()
+        trial = t.trials[0]
+        t.trial_attachments(trial)["blob"] = b"123"
+        assert t.trial_attachments(trial)["blob"] == b"123"
+        assert "blob" in t.trial_attachments(trial)
+        del t.trial_attachments(trial)["blob"]
+        assert "blob" not in t.trial_attachments(trial)
+
+    def test_delete_all(self):
+        t = Trials()
+        t.insert_trial_docs([make_trial(0, loss=1.0)])
+        t.refresh()
+        t.attachments["g"] = 1
+        t.delete_all()
+        assert len(t) == 0
+        assert t.attachments == {}
+
+    def test_trials_from_docs(self):
+        docs = [make_trial(0, loss=2.0)]
+        t = trials_from_docs(docs)
+        assert len(t) == 1
+
+    def test_history_soa_cache(self):
+        t = Trials()
+        t.insert_trial_docs(
+            [
+                make_trial(0, loss=1.0, vals=(0.1,)),
+                make_trial(1, loss=2.0, vals=(0.2,)),
+                make_trial(2, status=STATUS_FAIL),
+            ]
+        )
+        t.refresh()
+        h = t.history
+        assert list(h.loss_tids) == [0, 1]
+        assert list(h.losses) == [1.0, 2.0]
+        assert list(h.vals["x"]) == [0.1, 0.2]
+        # cache object stable until new completions
+        assert t.history is h
+
+    def test_view_shares_docs(self):
+        t = Trials()
+        t.insert_trial_docs([make_trial(0, loss=1.0, exp_key=None)])
+        t.refresh()
+        v = t.view()
+        assert len(v) == 1
+
+    def test_average_best_error_no_variance(self):
+        t = Trials()
+        t.insert_trial_docs([make_trial(0, loss=3.0), make_trial(1, loss=1.5)])
+        t.refresh()
+        assert t.average_best_error() == 1.5
+
+
+class TestMiscUtils:
+    def test_miscs_roundtrip(self):
+        miscs = [
+            {"tid": 0, "cmd": None, "idxs": {"a": [0], "b": []}, "vals": {"a": [1.0], "b": []}},
+            {"tid": 1, "cmd": None, "idxs": {"a": [1], "b": [1]}, "vals": {"a": [2.0], "b": [5]}},
+        ]
+        idxs, vals = miscs_to_idxs_vals(miscs)
+        assert idxs == {"a": [0, 1], "b": [1]}
+        assert vals == {"a": [1.0, 2.0], "b": [5]}
+
+        blank = [
+            {"tid": 0, "cmd": None, "idxs": {}, "vals": {}},
+            {"tid": 1, "cmd": None, "idxs": {}, "vals": {}},
+        ]
+        miscs_update_idxs_vals(blank, idxs, vals)
+        assert blank[0]["idxs"] == {"a": [0], "b": []}
+        assert blank[1]["vals"] == {"a": [2.0], "b": [5]}
+
+    def test_spec_from_misc(self):
+        misc = {"tid": 3, "idxs": {"a": [3], "b": []}, "vals": {"a": [7.5], "b": []}}
+        assert spec_from_misc(misc) == {"a": 7.5}
+
+    def test_validate_timeout(self):
+        validate_timeout(None)
+        validate_timeout(5)
+        for bad in (0, -1, True, "x"):
+            with pytest.raises(Exception):
+                validate_timeout(bad)
+
+    def test_validate_loss_threshold(self):
+        validate_loss_threshold(None)
+        validate_loss_threshold(-3.5)
+        for bad in (True, "x"):
+            with pytest.raises(Exception):
+                validate_loss_threshold(bad)
+
+
+class TestDomain:
+    def test_evaluate_scalar_result(self):
+        space = {"x": hp.uniform("x", -1, 1)}
+        domain = Domain(lambda cfg: cfg["x"] ** 2, space)
+        trials = Trials()
+        ctrl = Ctrl(trials)
+        result = domain.evaluate({"x": 0.5}, ctrl)
+        assert result == {"loss": 0.25, "status": STATUS_OK}
+
+    def test_evaluate_dict_result(self):
+        space = {"x": hp.uniform("x", -1, 1)}
+        domain = Domain(
+            lambda cfg: {"loss": abs(cfg["x"]), "status": STATUS_OK, "extra": 7},
+            space,
+        )
+        result = domain.evaluate({"x": -0.25}, Ctrl(Trials()))
+        assert result["loss"] == 0.25
+        assert result["extra"] == 7
+
+    def test_evaluate_conditional_space(self):
+        space = hp.choice(
+            "m",
+            [
+                {"kind": "a", "p": hp.uniform("p", 0, 1)},
+                {"kind": "b", "q": hp.uniform("q", 0, 1)},
+            ],
+        )
+        domain = Domain(
+            lambda cfg: cfg["p"] if cfg["kind"] == "a" else 1 + cfg["q"], space
+        )
+        r0 = domain.evaluate({"m": 0, "p": 0.3}, Ctrl(Trials()))
+        assert r0["loss"] == pytest.approx(0.3)
+        r1 = domain.evaluate({"m": 1, "q": 0.4}, Ctrl(Trials()))
+        assert r1["loss"] == pytest.approx(1.4)
+
+    def test_invalid_status_raises(self):
+        space = {"x": hp.uniform("x", 0, 1)}
+        domain = Domain(lambda cfg: {"status": "bogus"}, space)
+        with pytest.raises(InvalidResultStatus):
+            domain.evaluate({"x": 0.1}, Ctrl(Trials()))
+
+    def test_missing_loss_raises(self):
+        space = {"x": hp.uniform("x", 0, 1)}
+        domain = Domain(lambda cfg: {"status": STATUS_OK}, space)
+        with pytest.raises(InvalidLoss):
+            domain.evaluate({"x": 0.1}, Ctrl(Trials()))
+
+    def test_attachments_from_result(self):
+        space = {"x": hp.uniform("x", 0, 1)}
+        domain = Domain(
+            lambda cfg: {
+                "loss": 0.0,
+                "status": STATUS_OK,
+                "attachments": {"art": b"bytes"},
+            },
+            space,
+        )
+        trials = Trials()
+        tid = trials.new_trial_ids(1)[0]
+        docs = trials.new_trial_docs(
+            [tid],
+            [None],
+            [{"status": "new"}],
+            [{"tid": tid, "cmd": None, "idxs": {"x": [tid]}, "vals": {"x": [0.5]}}],
+        )
+        trials.insert_trial_docs(docs)
+        trials.refresh()
+        ctrl = Ctrl(trials, current_trial=trials.trials[0])
+        result = domain.evaluate({"x": 0.5}, ctrl)
+        assert "attachments" not in result
+        assert trials.trial_attachments(trials.trials[0])["art"] == b"bytes"
+
+    def test_params_exposed(self):
+        space = {"x": hp.uniform("x", 0, 1), "c": hp.choice("c", [1, 2])}
+        domain = Domain(lambda cfg: 0.0, space)
+        assert set(domain.params) == {"x", "c"}
