@@ -6,8 +6,81 @@ the ``fmin`` driver, the ``Trials`` store abstraction, and the algorithm
 suite (``rand``, ``anneal``, ``tpe``, ``atpe``, ``mix``) — with the numeric
 core (space sampling, TPE adaptive-Parzen fit + log-EI scoring) compiled to
 XLA via JAX and sharded across TPU meshes.
+
+The reference's two plugin boundaries are preserved exactly:
+``suggest(new_ids, domain, trials, seed)`` for algorithms, and ``Trials``
+subclassing for execution backends.
 """
 
-from . import pyll
+from . import hp, pyll
+from .base import (
+    JOB_STATE_CANCEL,
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    JOB_STATE_RUNNING,
+    JOB_STATES,
+    STATUS_FAIL,
+    STATUS_NEW,
+    STATUS_OK,
+    STATUS_RUNNING,
+    STATUS_STRINGS,
+    STATUS_SUSPENDED,
+    Ctrl,
+    Domain,
+    Trials,
+    trials_from_docs,
+)
+from .exceptions import (
+    AllTrialsFailed,
+    BadSearchSpace,
+    DuplicateLabel,
+    InvalidLoss,
+    InvalidResultStatus,
+    InvalidTrial,
+)
+from .fmin import (
+    FMinIter,
+    fmin,
+    fmin_pass_expr_memo_ctrl,
+    generate_trials_to_calculate,
+    space_eval,
+)
+from .algos import rand
+from .early_stop import no_progress_loss
 
 __version__ = "0.1.0"
+
+__all__ = [
+    "AllTrialsFailed",
+    "BadSearchSpace",
+    "Ctrl",
+    "Domain",
+    "DuplicateLabel",
+    "FMinIter",
+    "InvalidLoss",
+    "InvalidResultStatus",
+    "InvalidTrial",
+    "JOB_STATES",
+    "JOB_STATE_CANCEL",
+    "JOB_STATE_DONE",
+    "JOB_STATE_ERROR",
+    "JOB_STATE_NEW",
+    "JOB_STATE_RUNNING",
+    "STATUS_FAIL",
+    "STATUS_NEW",
+    "STATUS_OK",
+    "STATUS_RUNNING",
+    "STATUS_STRINGS",
+    "STATUS_SUSPENDED",
+    "Trials",
+    "fmin",
+    "fmin_pass_expr_memo_ctrl",
+    "generate_trials_to_calculate",
+    "hp",
+    "no_progress_loss",
+    "pyll",
+    "rand",
+    "space_eval",
+    "trials_from_docs",
+]

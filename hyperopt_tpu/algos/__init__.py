@@ -1,0 +1,10 @@
+"""Suggest algorithms.
+
+Every algorithm is a function ``suggest(new_ids, domain, trials, seed, ...)``
+returning new trial documents — the reference's plugin boundary
+(``hyperopt/base.py — Trials.fmin``, SURVEY.md §1), preserved exactly.
+"""
+
+from . import rand
+
+__all__ = ["rand"]

@@ -211,7 +211,7 @@ class _TrialsHistory:
     """
 
     def __init__(self):
-        self.n_done = -1
+        self._fingerprint = None
         self.idxs = {}
         self.vals = {}
         self.loss_tids = np.zeros(0, dtype=np.int64)
@@ -224,9 +224,18 @@ class _TrialsHistory:
             if t["state"] == JOB_STATE_DONE
             and t["result"].get("status") == STATUS_OK
         ]
-        if len(docs) == self.n_done:
+        # fingerprint on the (tid, loss) content, not just the count:
+        # in-place result mutation or a same-count swap must invalidate
+        fp_tids = np.fromiter((t["tid"] for t in docs), dtype=np.int64, count=len(docs))
+        fp_losses = np.fromiter(
+            (float(t["result"].get("loss", np.nan)) for t in docs),
+            dtype=np.float64,
+            count=len(docs),
+        )
+        fingerprint = (len(docs), fp_tids.tobytes(), fp_losses.tobytes())
+        if fingerprint == self._fingerprint:
             return
-        self.n_done = len(docs)
+        self._fingerprint = fingerprint
         loss_tids, losses = [], []
         idxs = {}
         vals = {}
@@ -484,12 +493,11 @@ class Trials:
             if t["result"].get("status") == STATUS_OK
             and t["state"] == JOB_STATE_DONE
             and t["result"].get("loss") is not None
+            and not np.isnan(float(t["result"]["loss"]))
         ]
         if not candidates:
             raise AllTrialsFailed
         losses = [float(t["result"]["loss"]) for t in candidates]
-        if any(np.isnan(l) for l in losses):
-            raise AllTrialsFailed
         return candidates[int(np.argmin(losses))]
 
     @property
@@ -558,6 +566,7 @@ class Trials:
         show_progressbar=True,
         early_stop_fn=None,
         trials_save_file="",
+        points_to_evaluate=None,
     ):
         """Minimize ``fn`` over ``space`` using this store (see ``fmin``)."""
         from .fmin import fmin as _fmin  # local import: avoid circularity
@@ -574,6 +583,7 @@ class Trials:
             verbose=verbose,
             max_queue_len=max_queue_len,
             allow_trials_fmin=False,
+            points_to_evaluate=points_to_evaluate,
             pass_expr_memo_ctrl=pass_expr_memo_ctrl,
             catch_eval_exceptions=catch_eval_exceptions,
             return_argmin=return_argmin,

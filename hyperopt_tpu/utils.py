@@ -74,6 +74,7 @@ def fast_isin(X, Y):
     D = T.searchsorted(X)
     T = np.append(T, np.array([0]))
     W = T[D] == X
+    W[D == len(T) - 1] = False  # searchsorted past the end: not a member
     if isinstance(W, bool):
         return np.zeros(len(X), dtype=bool)
     return W

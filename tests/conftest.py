@@ -1,15 +1,28 @@
-"""Test configuration.
+"""Test configuration: force the suite onto a virtual 8-device CPU mesh.
 
-Runs the suite on a virtual 8-device CPU mesh (the prescribed way to test
-TPU sharding logic without a pod); must set env vars before jax initializes.
-Benchmarks (bench.py) run separately on the real TPU chip.
+This is the prescribed way to test TPU sharding logic without a pod
+(SURVEY.md §4 pattern 3).  Two subtleties in this environment:
+
+- ``XLA_FLAGS`` must be in the env before the CPU backend initializes.
+- The axon TPU plugin's sitecustomize calls
+  ``jax.config.update("jax_platforms", "axon,cpu")`` in *every* Python
+  process, clobbering the ``JAX_PLATFORMS`` env var — so we must update
+  the config back to ``cpu`` here, before any JAX operation runs.
+  (Running the suite through the remote-TPU tunnel makes every jit
+  compile a network round-trip: 30x slower and single-process-locked.)
+
+Benchmarks (bench.py) run separately and do use the real TPU chip.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402  (import after env setup, before any test imports)
+
+jax.config.update("jax_platforms", "cpu")

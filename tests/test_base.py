@@ -324,3 +324,42 @@ class TestDomain:
         space = {"x": hp.uniform("x", 0, 1), "c": hp.choice("c", [1, 2])}
         domain = Domain(lambda cfg: 0.0, space)
         assert set(domain.params) == {"x", "c"}
+
+
+class TestReviewRegressions:
+    """Regressions from code review: NaN argmin, fast_isin bounds, history
+    cache invalidation on in-place mutation."""
+
+    def test_best_trial_skips_nan_losses(self):
+        t = Trials()
+        t.insert_trial_docs(
+            [make_trial(0, loss=float("nan")), make_trial(1, loss=1.0)]
+        )
+        t.refresh()
+        assert t.best_trial["tid"] == 1
+
+    def test_all_nan_losses_raises(self):
+        t = Trials()
+        t.insert_trial_docs([make_trial(0, loss=float("nan"))])
+        t.refresh()
+        with pytest.raises(AllTrialsFailed):
+            t.best_trial
+
+    def test_fast_isin_out_of_range(self):
+        from hyperopt_tpu.utils import fast_isin
+
+        assert list(fast_isin(np.array([0]), np.array([-2, -1]))) == [False]
+        assert list(fast_isin(np.array([-2, 0, 5]), np.array([-2, 3, 5]))) == [
+            True,
+            False,
+            True,
+        ]
+
+    def test_history_invalidated_on_mutation(self):
+        t = Trials()
+        t.insert_trial_docs([make_trial(0, loss=1.0), make_trial(1, loss=2.0)])
+        t.refresh()
+        assert list(t.history.losses) == [1.0, 2.0]
+        t.trials[1]["result"]["loss"] = 99.0
+        t.refresh()
+        assert list(t.history.losses) == [1.0, 99.0]

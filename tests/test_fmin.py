@@ -1,0 +1,305 @@
+"""End-to-end driver tests (reference parity: hyperopt/tests/test_fmin.py):
+warm start, early stop, timeout, loss_threshold, save/resume, exceptions,
+space_eval, determinism.
+"""
+
+import os
+import pickle
+import time
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import (
+    STATUS_FAIL,
+    STATUS_OK,
+    Trials,
+    fmin,
+    hp,
+    no_progress_loss,
+    space_eval,
+    trials_from_docs,
+)
+from hyperopt_tpu.algos import rand
+from hyperopt_tpu.base import JOB_STATE_ERROR
+from hyperopt_tpu.exceptions import AllTrialsFailed
+from hyperopt_tpu.models import domains
+
+
+def quad(c):
+    return (c["x"] - 3) ** 2
+
+
+QSPACE = {"x": hp.uniform("x", -5, 5)}
+
+
+def test_fmin_rand_quadratic():
+    trials = Trials()
+    best = fmin(
+        quad,
+        QSPACE,
+        algo=rand.suggest,
+        max_evals=100,
+        trials=trials,
+        rstate=np.random.default_rng(0),
+        show_progressbar=False,
+        verbose=False,
+    )
+    assert len(trials) == 100
+    assert abs(best["x"] - 3) < 0.5
+    assert min(trials.losses()) < 0.2
+
+
+def test_fmin_determinism():
+    def run():
+        return fmin(
+            quad,
+            QSPACE,
+            algo=rand.suggest,
+            max_evals=20,
+            rstate=np.random.default_rng(123),
+            show_progressbar=False,
+            verbose=False,
+        )
+
+    assert run() == run()
+
+
+def test_points_to_evaluate():
+    trials = Trials()
+    fmin(
+        quad,
+        QSPACE,
+        algo=rand.suggest,
+        max_evals=5,
+        trials=trials,
+        points_to_evaluate=[{"x": 3.0}, {"x": -4.0}],
+        rstate=np.random.default_rng(0),
+        show_progressbar=False,
+        verbose=False,
+    )
+    assert trials.trials[0]["misc"]["vals"]["x"] == [3.0]
+    assert trials.trials[1]["misc"]["vals"]["x"] == [-4.0]
+    assert trials.results[0]["loss"] == 0.0
+    assert len(trials) == 5
+
+
+def test_points_to_evaluate_without_trials():
+    best = fmin(
+        quad,
+        QSPACE,
+        algo=rand.suggest,
+        max_evals=3,
+        points_to_evaluate=[{"x": 3.0}],
+        rstate=np.random.default_rng(0),
+        show_progressbar=False,
+        verbose=False,
+    )
+    assert best["x"] == 3.0
+
+
+def test_early_stop_no_progress_loss():
+    trials = Trials()
+    fmin(
+        lambda c: 10.0,  # never improves
+        QSPACE,
+        algo=rand.suggest,
+        max_evals=500,
+        trials=trials,
+        early_stop_fn=no_progress_loss(10),
+        rstate=np.random.default_rng(0),
+        show_progressbar=False,
+        verbose=False,
+    )
+    assert len(trials) < 30
+
+
+def test_loss_threshold_stops():
+    trials = Trials()
+    fmin(
+        quad,
+        QSPACE,
+        algo=rand.suggest,
+        max_evals=10000,
+        trials=trials,
+        loss_threshold=5.0,
+        rstate=np.random.default_rng(0),
+        show_progressbar=False,
+        verbose=False,
+    )
+    assert len(trials) < 10000
+    assert min(trials.losses()) <= 5.0
+
+
+def test_timeout_stops():
+    trials = Trials()
+    t0 = time.time()
+    fmin(
+        lambda c: time.sleep(0.02) or quad(c),
+        QSPACE,
+        algo=rand.suggest,
+        max_evals=100000,
+        trials=trials,
+        timeout=0.5,
+        rstate=np.random.default_rng(0),
+        show_progressbar=False,
+        verbose=False,
+    )
+    assert time.time() - t0 < 5.0
+    assert 0 < len(trials) < 100000
+
+
+def test_trials_save_file_resume(tmp_path):
+    save = str(tmp_path / "trials.pkl")
+    fmin(
+        quad, QSPACE, algo=rand.suggest, max_evals=10,
+        rstate=np.random.default_rng(0), trials_save_file=save,
+        show_progressbar=False, verbose=False,
+    )
+    with open(save, "rb") as f:
+        t1 = pickle.load(f)
+    assert len(t1) == 10
+    # resume: continues from the saved file up to 25 total
+    fmin(
+        quad, QSPACE, algo=rand.suggest, max_evals=25,
+        rstate=np.random.default_rng(1), trials_save_file=save,
+        show_progressbar=False, verbose=False,
+    )
+    with open(save, "rb") as f:
+        t2 = pickle.load(f)
+    assert len(t2) == 25
+    # first 10 trials identical to the first run
+    assert [t["tid"] for t in t2.trials[:10]] == [t["tid"] for t in t1.trials]
+
+
+def test_catch_eval_exceptions():
+    calls = []
+
+    def sometimes_fails(c):
+        calls.append(1)
+        if len(calls) % 3 == 0:
+            raise RuntimeError("boom")
+        return quad(c)
+
+    trials = Trials()
+    fmin(
+        sometimes_fails,
+        QSPACE,
+        algo=rand.suggest,
+        max_evals=10,
+        trials=trials,
+        catch_eval_exceptions=True,
+        rstate=np.random.default_rng(0),
+        show_progressbar=False,
+        verbose=False,
+    )
+    errors = [t for t in trials._dynamic_trials if t["state"] == JOB_STATE_ERROR]
+    assert len(errors) >= 1
+    assert all("boom" in t["misc"]["error"][1] for t in errors)
+    # error trials are filtered from the refreshed view
+    assert all(t["state"] != JOB_STATE_ERROR for t in trials.trials)
+
+
+def test_uncaught_exception_propagates():
+    def always_fails(c):
+        raise RuntimeError("kaput")
+
+    with pytest.raises(RuntimeError, match="kaput"):
+        fmin(
+            always_fails, QSPACE, algo=rand.suggest, max_evals=3,
+            rstate=np.random.default_rng(0),
+            show_progressbar=False, verbose=False,
+        )
+
+
+def test_status_fail_trials_excluded_from_argmin():
+    def fn(c):
+        if c["x"] < 0:
+            return {"status": STATUS_FAIL}
+        return {"status": STATUS_OK, "loss": quad(c)}
+
+    trials = Trials()
+    best = fmin(
+        fn, QSPACE, algo=rand.suggest, max_evals=50, trials=trials,
+        rstate=np.random.default_rng(0), show_progressbar=False, verbose=False,
+    )
+    assert best["x"] >= 0
+
+
+def test_all_trials_failed_raises():
+    def fn(c):
+        return {"status": STATUS_FAIL}
+
+    with pytest.raises(AllTrialsFailed):
+        fmin(
+            fn, QSPACE, algo=rand.suggest, max_evals=5,
+            rstate=np.random.default_rng(0),
+            show_progressbar=False, verbose=False,
+        )
+
+
+def test_return_argmin_false():
+    rval = fmin(
+        quad, QSPACE, algo=rand.suggest, max_evals=3,
+        rstate=np.random.default_rng(0), return_argmin=False,
+        show_progressbar=False, verbose=False,
+    )
+    assert rval is None
+
+
+def test_trials_fmin_method():
+    trials = Trials()
+    best = trials.fmin(
+        quad, QSPACE, algo=rand.suggest, max_evals=10,
+        rstate=np.random.default_rng(0), show_progressbar=False, verbose=False,
+    )
+    assert len(trials) == 10
+    assert "x" in best
+
+
+def test_space_eval_roundtrip():
+    space = hp.choice(
+        "m",
+        [
+            {"kind": "a", "p": hp.uniform("p", 0, 1)},
+            {"kind": "b", "q": hp.loguniform("q", -2, 2)},
+        ],
+    )
+    assert space_eval(space, {"m": 0, "p": 0.5}) == {"kind": "a", "p": 0.5}
+    out = space_eval(space, {"m": 1, "q": 1.5})
+    assert out["kind"] == "b" and out["q"] == 1.5
+
+
+def test_fmin_conditional_space_end_to_end():
+    d = domains.get("q1_choice")
+    trials = Trials()
+    best = fmin(
+        d.fn, d.space, algo=rand.suggest, max_evals=d.quality_evals,
+        trials=trials, rstate=np.random.default_rng(0),
+        show_progressbar=False, verbose=False,
+    )
+    assert min(trials.losses()) < d.quality_threshold
+    # each trial has exactly one of xl/xr active
+    for m in trials.miscs:
+        assert (len(m["idxs"]["xl"]) == 1) != (len(m["idxs"]["xr"]) == 1)
+
+
+def test_fmin_progressbar_smoke(capsys):
+    fmin(
+        quad, QSPACE, algo=rand.suggest, max_evals=5,
+        rstate=np.random.default_rng(0), verbose=False,
+    )  # default show_progressbar=True exercises tqdm path
+
+
+def test_max_queue_len_batching():
+    seen_batches = []
+
+    def counting_suggest(new_ids, domain, trials, seed):
+        seen_batches.append(len(new_ids))
+        return rand.suggest(new_ids, domain, trials, seed)
+
+    fmin(
+        quad, QSPACE, algo=counting_suggest, max_evals=12, max_queue_len=4,
+        rstate=np.random.default_rng(0), show_progressbar=False, verbose=False,
+    )
+    assert max(seen_batches) == 4

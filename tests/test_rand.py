@@ -1,0 +1,66 @@
+"""Random-search tests (reference parity: hyperopt/tests/test_rand.py):
+distributional sanity over benchmark domains + doc structure.
+"""
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Domain, Trials, fmin
+from hyperopt_tpu.algos import rand
+from hyperopt_tpu.models import domains
+
+
+def test_suggest_doc_structure():
+    d = domains.get("branin")
+    domain = Domain(d.fn, d.space)
+    trials = Trials()
+    ids = trials.new_trial_ids(5)
+    docs = rand.suggest(ids, domain, trials, seed=42)
+    assert len(docs) == 5
+    for doc, tid in zip(docs, ids):
+        assert doc["tid"] == tid
+        assert doc["misc"]["idxs"]["x"] == [tid]
+        assert isinstance(doc["misc"]["vals"]["x"][0], float)
+        assert -5 <= doc["misc"]["vals"]["x"][0] <= 10
+
+
+def test_suggest_deterministic_in_seed():
+    d = domains.get("branin")
+    domain = Domain(d.fn, d.space)
+    trials = Trials()
+    ids = [0, 1, 2]
+    a = rand.suggest(ids, domain, trials, seed=7)
+    b = rand.suggest(ids, domain, trials, seed=7)
+    assert [x["misc"]["vals"] for x in a] == [x["misc"]["vals"] for x in b]
+    c = rand.suggest(ids, domain, trials, seed=8)
+    assert [x["misc"]["vals"] for x in a] != [x["misc"]["vals"] for x in c]
+
+
+@pytest.mark.parametrize("name", ["quadratic1", "gauss_wave", "branin", "many_dists"])
+def test_rand_quality_on_domains(name):
+    d = domains.get(name)
+    trials = Trials()
+    fmin(
+        d.fn,
+        d.space,
+        algo=rand.suggest,
+        max_evals=d.quality_evals * 2,  # rand gets 2x budget vs guided algos
+        trials=trials,
+        rstate=np.random.default_rng(0),
+        show_progressbar=False,
+        verbose=False,
+    )
+    assert min(trials.losses()) < d.quality_threshold * (
+        1 if d.quality_threshold < 0 else 1.5
+    ) + (0.3 if name == "branin" else 0.0)
+
+
+def test_rand_covers_space():
+    d = domains.get("many_dists")
+    domain = Domain(d.fn, d.space)
+    trials = Trials()
+    docs = rand.suggest(list(range(200)), domain, trials, seed=0)
+    a_vals = [doc["misc"]["vals"]["a"][0] for doc in docs]
+    assert set(a_vals) == {0, 1, 2}
+    k_vals = [doc["misc"]["vals"]["k"][0] for doc in docs]
+    assert np.mean(k_vals) > 0.75  # pchoice weights respected
