@@ -5,6 +5,6 @@ returning new trial documents — the reference's plugin boundary
 (``hyperopt/base.py — Trials.fmin``, SURVEY.md §1), preserved exactly.
 """
 
-from . import rand
+from . import rand, tpe
 
-__all__ = ["rand"]
+__all__ = ["rand", "tpe"]

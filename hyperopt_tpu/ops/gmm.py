@@ -1,0 +1,158 @@
+"""Truncated (log-)GMM sampling and log-density scoring kernels.
+
+Reference parity (SURVEY.md §2 #11): ``hyperopt/tpe.py`` — ``GMM1``,
+``GMM1_lpdf``, ``LGMM1``, ``LGMM1_lpdf`` and the q-variants via
+``normal_cdf``/``lognormal_cdf`` erf sums (~L200-520).
+
+Semantics notes (match the reference exactly, by construction):
+- Truncation: the reference rejection-samples the *mixture* restricted to
+  ``[low, high)``, i.e. density ∝ Σ wᵢ N(x; μᵢ, σᵢ) on the interval with a
+  single global normalizer ``p_accept = Σ wᵢ (Φᵢ(high) − Φᵢ(low))``.  The
+  XLA-friendly equivalent here: re-weight components by their in-bounds
+  mass (``wᵢ·Zᵢ``), then draw an exact truncated normal within the chosen
+  component — same joint density, zero rejection loops.
+- Log-scale (``LGMM1``): the mixture lives in log space; truncation bounds
+  are log-space bounds; samples are exponentiated.
+- Quantization: ``round(x/q)·q`` buckets; lpdf integrates the bucket via
+  CDF differences (the reference's two-sided erf sum).
+
+These are THE hot kernels: scoring is O(candidates × mixture components) =
+O(candidates × history), evaluated as one fused ``[C, K]`` broadcast that
+XLA tiles across the VPU — and, for pod-scale history, sharded over the
+mesh's history axis (see ``hyperopt_tpu.parallel.sharding``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import logsumexp, ndtr
+
+_SQRT_2PI = 2.5066282746310002
+EPS = 1e-12
+
+
+def _safe_log(x):
+    return jnp.log(jnp.maximum(x, EPS))
+
+
+def _cdf(v, mu, sigma):
+    """Normal CDF Φ((v−μ)/σ), safe for ±inf v."""
+    z = (v - mu) / jnp.maximum(sigma, EPS)
+    return ndtr(jnp.clip(z, -40.0, 40.0))
+
+
+def _log_cdf_arg(v):
+    """log of a raw-space quantized bound, mapping v<=0 to -inf (CDF 0)."""
+    return jnp.where(v > 0, jnp.log(jnp.maximum(v, EPS)), -jnp.inf)
+
+
+def _p_accept(w, mu, sigma, low, high):
+    """Global in-bounds mixture mass (the reference's rejection acceptance)."""
+    return jnp.sum(w * (_cdf(high, mu, sigma) - _cdf(low, mu, sigma)))
+
+
+@partial(jax.jit, static_argnames=("n_samples", "log_scale"))
+def gmm_sample(key, w, mu, sigma, low, high, q, n_samples: int, log_scale: bool):
+    """Draw ``n_samples`` from the truncated (log-)GMM.
+
+    ``low``/``high`` are (log-space if ``log_scale``) truncation bounds —
+    pass ±inf for unbounded.  ``q <= 0`` disables quantization.
+    """
+    k_comp, k_val = jax.random.split(key)
+    a = (low - mu) / jnp.maximum(sigma, EPS)
+    b = (high - mu) / jnp.maximum(sigma, EPS)
+    a = jnp.clip(a, -30.0, 30.0)
+    b = jnp.clip(b, -30.0, 30.0)
+    Z = ndtr(b) - ndtr(a)
+    comp = jax.random.categorical(k_comp, _safe_log(w * Z), shape=(n_samples,))
+    u = jax.random.truncated_normal(k_val, a[comp], b[comp])
+    x = mu[comp] + sigma[comp] * u
+    if log_scale:
+        x = jnp.exp(x)
+    x = jnp.where(q > 0, jnp.round(x / jnp.maximum(q, EPS)) * q, x)
+    return x
+
+
+@partial(jax.jit, static_argnames=("log_scale", "quantized"))
+def gmm_lpdf(x, w, mu, sigma, low, high, q, log_scale: bool, quantized: bool):
+    """Log-density of ``x`` ([C]) under the truncated (log-)GMM ([K]).
+
+    The [C, K] broadcast below is the O(candidates × history) hot loop.
+    """
+    sigma = jnp.maximum(sigma, EPS)
+    logw = _safe_log(w)
+    p_accept = _p_accept(w, mu, sigma, low, high)
+
+    if not quantized:
+        if log_scale:
+            z = jnp.where(x > 0, jnp.log(jnp.maximum(x, EPS)), -jnp.inf)
+            jacobian = _safe_log(x)  # d(log x)/dx term of the lognormal pdf
+        else:
+            z = x
+            jacobian = jnp.zeros_like(x)
+        mahal = ((z[:, None] - mu[None, :]) / sigma[None, :]) ** 2
+        comp_ll = -0.5 * mahal - jnp.log(sigma * _SQRT_2PI)[None, :] + logw[None, :]
+        ll = logsumexp(comp_ll, axis=1) - jacobian - _safe_log(p_accept)
+        # out-of-bounds or non-positive (log-scale) points have density 0
+        if log_scale:
+            in_bounds = (z >= low) & (z < high) & (x > 0)
+        else:
+            in_bounds = (x >= low) & (x < high)
+        return jnp.where(in_bounds, ll, -jnp.inf)
+
+    # quantized: integrate the bucket [x - q/2, x + q/2] ∩ bounds
+    qq = jnp.maximum(q, EPS)
+    if log_scale:
+        raw_low = jnp.where(jnp.isfinite(low), jnp.exp(low), 0.0)
+        raw_high = jnp.where(jnp.isfinite(high), jnp.exp(high), jnp.inf)
+        ub = jnp.minimum(x + qq / 2.0, raw_high)
+        lb = jnp.maximum(jnp.maximum(x - qq / 2.0, raw_low), 0.0)
+        ub_z = _log_cdf_arg(ub)
+        lb_z = _log_cdf_arg(lb)
+    else:
+        ub_z = jnp.minimum(x + qq / 2.0, high)
+        lb_z = jnp.maximum(x - qq / 2.0, low)
+    prob = jnp.sum(
+        w[None, :]
+        * (
+            _cdf(ub_z[:, None], mu[None, :], sigma[None, :])
+            - _cdf(lb_z[:, None], mu[None, :], sigma[None, :])
+        ),
+        axis=1,
+    )
+    return _safe_log(prob) - _safe_log(p_accept)
+
+
+# ---------------------------------------------------------------------
+# Categorical posterior kernels
+# ---------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("upper", "lf"))
+def categorical_posterior(obs, n_obs, prior_p, prior_weight, upper: int, lf: int):
+    """Posterior category probabilities: forgetting-weighted counts plus
+    ``upper · prior_weight · prior_p`` pseudocounts (reference:
+    ``hyperopt/tpe.py`` — categorical posterior ~L520-570)."""
+    from .parzen import linear_forgetting_weights_padded
+
+    pad = obs.shape[0]
+    w_chrono = linear_forgetting_weights_padded(n_obs, lf, pad)
+    obs_idx = jnp.clip(obs.astype(jnp.int32), 0, upper - 1)
+    counts = jnp.zeros(upper, jnp.float32).at[obs_idx].add(w_chrono)
+    pseudocounts = counts + upper * prior_weight * prior_p
+    return pseudocounts / jnp.sum(pseudocounts)
+
+
+@partial(jax.jit, static_argnames=("n_samples",))
+def categorical_sample(key, p, n_samples: int):
+    return jax.random.categorical(key, _safe_log(p), shape=(n_samples,)).astype(
+        jnp.int32
+    )
+
+
+@jax.jit
+def categorical_lpdf(x, p):
+    return _safe_log(p)[jnp.clip(x.astype(jnp.int32), 0, p.shape[0] - 1)]

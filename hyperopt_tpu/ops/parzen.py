@@ -1,0 +1,124 @@
+"""Adaptive-Parzen estimator fit as a fixed-shape XLA kernel.
+
+Reference parity (SURVEY.md §2 #11): ``hyperopt/tpe.py`` —
+``adaptive_parzen_normal`` / ``linear_forgetting_weights`` (~L40-200): the
+per-observation sigma heuristic (max of neighbor gaps in sorted order),
+prior-as-extra-component insertion at the sorted position, sigma clamping to
+``[prior_sigma/min(100, 1+K), prior_sigma]``, the one-observation special
+case (``sigma = prior_sigma/2``), and linear-forgetting ramp weights over
+chronological order.
+
+TPU-first redesign: the reference refits with numpy per label per suggest
+(O(history log history) Python).  Here the fit is one jitted program over a
+**padded** observation buffer (``PAD`` static, ``n_obs`` dynamic) so history
+growth never recompiles within a bucket; invalid slots carry weight 0.
+Sorting, prior insertion (scatter), neighbor gaps, and ramp weights are all
+fixed-shape array ops that fuse into the downstream GMM scoring kernel.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def bucket(n: int, minimum: int = 8) -> int:
+    """Power-of-two padding bucket: bounds jit recompiles to O(log history)."""
+    n = max(int(n), 1)
+    return max(minimum, 1 << (n - 1).bit_length())
+
+
+def linear_forgetting_weights_padded(n_obs, lf: int, pad: int):
+    """Chronological observation weights, padded to ``pad``.
+
+    Oldest ``n_obs - lf`` observations get a linear ramp from ``1/n_obs`` to
+    1; the newest ``lf`` get weight 1.  ``lf <= 0`` disables forgetting.
+    """
+    i = jnp.arange(pad, dtype=jnp.float32)
+    n = jnp.maximum(n_obs, 1).astype(jnp.float32)
+    ramp_len = n_obs - lf  # dynamic
+    denom = jnp.maximum(ramp_len - 1, 1).astype(jnp.float32)
+    ramp = 1.0 / n + (1.0 - 1.0 / n) * i / denom
+    w = jnp.where(i < ramp_len, ramp, 1.0)
+    use_ramp = (lf > 0) & (n_obs > lf)
+    w = jnp.where(use_ramp, w, 1.0)
+    return jnp.where(i < n_obs, w, 0.0)
+
+
+@partial(jax.jit, static_argnames=("lf",))
+def adaptive_parzen_normal_padded(
+    obs, n_obs, prior_weight, prior_mu, prior_sigma, lf: int
+):
+    """Fit the adaptive Parzen mixture on a padded observation buffer.
+
+    Args:
+      obs: ``[PAD]`` observation values in *chronological* order; only the
+        first ``n_obs`` entries are valid.
+      n_obs: dynamic count of valid observations.
+      prior_weight / prior_mu / prior_sigma: the prior component.
+      lf: linear-forgetting horizon (static; 0 disables).
+
+    Returns:
+      ``(weights, mus, sigmas)`` each ``[PAD+1]`` — the mixture in sorted-mu
+      order with the prior inserted at its sorted position; the first
+      ``n_obs + 1`` entries are valid, the rest have weight exactly 0.
+    """
+    pad = obs.shape[0]
+    K = pad + 1
+    f32 = jnp.float32
+    obs = obs.astype(f32)
+    i_pad = jnp.arange(pad)
+    i_out = jnp.arange(K)
+    valid = i_pad < n_obs
+
+    big = jnp.where(valid, obs, jnp.inf)
+    order = jnp.argsort(big)  # valid obs sorted to the front
+    srtd = big[order]
+
+    # searchsorted-left position of the prior among valid observations
+    prior_pos = jnp.sum(jnp.where(valid, obs < prior_mu, False))
+
+    # scatter sorted obs around the prior slot
+    out_pos = i_pad + (i_pad >= prior_pos)
+    srtd_mus = (
+        jnp.zeros(K, f32)
+        .at[out_pos]
+        .set(jnp.where(i_pad < n_obs, srtd, 0.0))
+        .at[prior_pos]
+        .set(prior_mu)
+    )
+
+    n_tot = n_obs + 1
+    prev = srtd_mus[jnp.maximum(i_out - 1, 0)]
+    nxt = srtd_mus[jnp.minimum(i_out + 1, K - 1)]
+    left_gap = srtd_mus - prev
+    right_gap = nxt - srtd_mus
+    sigma = jnp.maximum(left_gap, right_gap)
+    sigma = jnp.where(i_out == 0, right_gap, sigma)
+    sigma = jnp.where(i_out == n_tot - 1, left_gap, sigma)
+    # one observation: the non-prior component gets prior_sigma/2
+    sigma = jnp.where(
+        (n_obs == 1) & (i_out != prior_pos), 0.5 * prior_sigma, sigma
+    )
+
+    maxsigma = prior_sigma
+    minsigma = prior_sigma / jnp.minimum(100.0, 1.0 + n_tot.astype(f32))
+    sigma = jnp.clip(sigma, minsigma, maxsigma)
+    sigma = sigma.at[prior_pos].set(prior_sigma)
+
+    # chronological forgetting weights -> sorted order -> prior inserted
+    w_chrono = linear_forgetting_weights_padded(n_obs, lf, pad)
+    w_sorted = w_chrono[order]
+    srtd_w = (
+        jnp.zeros(K, f32)
+        .at[out_pos]
+        .set(jnp.where(i_pad < n_obs, w_sorted, 0.0))
+        .at[prior_pos]
+        .set(prior_weight)
+    )
+    srtd_w = jnp.where(i_out < n_tot, srtd_w, 0.0)
+    srtd_w = srtd_w / jnp.sum(srtd_w)
+
+    return srtd_w, srtd_mus, sigma

@@ -1,0 +1,283 @@
+"""TPE tests (reference parity: hyperopt/tests/test_tpe.py, the largest
+suite): golden adaptive-Parzen cases, statistical sampler-vs-lpdf agreement,
+seeded determinism, startup behavior, and optimization-quality thresholds
+over the benchmark domain zoo.
+"""
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Domain, Trials, fmin, hp
+from hyperopt_tpu.algos import rand, tpe
+from hyperopt_tpu.models import domains
+
+
+class TestAdaptiveParzen:
+    def test_empty_obs_prior_only(self):
+        w, m, s = tpe.adaptive_parzen_normal([], 1.0, 0.0, 2.0)
+        np.testing.assert_allclose(w, [1.0])
+        np.testing.assert_allclose(m, [0.0])
+        np.testing.assert_allclose(s, [2.0])
+
+    def test_one_obs_below_prior(self):
+        w, m, s = tpe.adaptive_parzen_normal([-1.0], 1.0, 0.0, 2.0)
+        # sorted: obs at -1, prior at 0
+        np.testing.assert_allclose(m, [-1.0, 0.0])
+        np.testing.assert_allclose(s, [1.0, 2.0])  # obs sigma = prior/2
+        np.testing.assert_allclose(w, [0.5, 0.5])
+
+    def test_one_obs_above_prior(self):
+        w, m, s = tpe.adaptive_parzen_normal([1.5], 2.0, 0.0, 2.0)
+        np.testing.assert_allclose(m, [0.0, 1.5])
+        np.testing.assert_allclose(s, [2.0, 1.0])
+        np.testing.assert_allclose(w, [2 / 3, 1 / 3])
+
+    def test_multi_obs_neighbor_gap_sigmas(self):
+        # obs [1, 4, 6], prior at 0, prior_sigma 10
+        w, m, s = tpe.adaptive_parzen_normal([4.0, 1.0, 6.0], 1.0, 0.0, 10.0)
+        np.testing.assert_allclose(m, [0.0, 1.0, 4.0, 6.0])
+        # sigma[1] = max(1-0, 4-1) = 3; sigma[2] = max(4-1, 6-4)=3;
+        # sigma[3] (last) = 6-4 = 2; prior slot = prior_sigma
+        assert s[0] == 10.0
+        np.testing.assert_allclose(s[1:], [3.0, 3.0, 2.0])
+        np.testing.assert_allclose(w, [0.25, 0.25, 0.25, 0.25])
+
+    def test_sigma_clipping(self):
+        # duplicate observations -> zero gaps clipped to minsigma
+        w, m, s = tpe.adaptive_parzen_normal([5.0, 5.0, 5.0], 1.0, 0.0, 1.0)
+        minsigma = 1.0 / min(100.0, 1.0 + 4.0)
+        assert np.all(s[1:] >= minsigma - 1e-6)
+
+    def test_prior_insertion_position(self):
+        w, m, s = tpe.adaptive_parzen_normal([1.0, 3.0], 1.0, 2.0, 5.0)
+        np.testing.assert_allclose(m, [1.0, 2.0, 3.0])
+        assert s[1] == 5.0  # prior slot keeps prior sigma
+
+    def test_linear_forgetting_downweights_old(self):
+        obs = list(np.linspace(-3, 3, 40))
+        w, m, s = tpe.adaptive_parzen_normal(obs, 1.0, 0.0, 6.0, LF=10)
+        # chronologically-oldest obs is obs[0] = -3.0 (smallest -> index 0
+        # or 1 in sorted order, after prior at pos of 0.0)
+        idx_old = int(np.argmin(np.abs(m - (-3.0))))
+        idx_new = int(np.argmin(np.abs(m - 3.0)))
+        assert w[idx_old] < w[idx_new]
+
+    def test_matches_reference_weight_function(self):
+        np.testing.assert_allclose(
+            tpe.linear_forgetting_weights(30, 25),
+            np.concatenate([np.linspace(1 / 30, 1.0, 5), np.ones(25)]),
+        )
+        np.testing.assert_allclose(tpe.linear_forgetting_weights(10, 25), np.ones(10))
+
+
+class TestGMMStatistical:
+    """Histogram-vs-exp(lpdf) agreement — the reference's signature test."""
+
+    def _hist_check(self, samples, lpdf_fn, lo, hi, atol=0.05):
+        nbins = 30
+        hist, edges = np.histogram(samples, bins=nbins, range=(lo, hi), density=True)
+        centers = 0.5 * (edges[1:] + edges[:-1])
+        dens = np.exp(lpdf_fn(centers))
+        assert np.allclose(hist, dens, atol=atol * dens.max() + 0.02), (
+            np.abs(hist - dens).max()
+        )
+
+    def test_gmm1_bounded(self):
+        w = [0.4, 0.6]
+        mu = [1.0, 4.0]
+        s = [0.7, 1.2]
+        rng = np.random.default_rng(0)
+        x = tpe.GMM1(w, mu, s, low=0.0, high=6.0, rng=rng, size=(40000,))
+        assert x.min() >= 0.0 and x.max() < 6.0
+        self._hist_check(
+            x, lambda c: tpe.GMM1_lpdf(c, w, mu, s, low=0.0, high=6.0), 0.0, 6.0
+        )
+
+    def test_gmm1_unbounded(self):
+        w = [1.0]
+        mu = [2.0]
+        s = [1.5]
+        x = tpe.GMM1(w, mu, s, rng=np.random.default_rng(1), size=(40000,))
+        self._hist_check(x, lambda c: tpe.GMM1_lpdf(c, w, mu, s), -3.0, 7.0)
+
+    def test_gmm1_lpdf_integrates_to_one(self):
+        w = [0.3, 0.7]
+        mu = [-1.0, 2.0]
+        s = [0.5, 1.0]
+        grid = np.linspace(-2.0, 4.0, 4001)
+        dens = np.exp(tpe.GMM1_lpdf(grid, w, mu, s, low=-2.0, high=4.0))
+        integral = np.trapezoid(dens, grid)
+        assert abs(integral - 1.0) < 0.01
+
+    def test_lgmm1_support_and_density(self):
+        w = [1.0]
+        mu = [0.5]
+        s = [0.6]
+        x = tpe.LGMM1(w, mu, s, rng=np.random.default_rng(2), size=(40000,))
+        assert x.min() > 0
+        grid = np.linspace(0.05, 8.0, 2001)
+        dens = np.exp(tpe.LGMM1_lpdf(grid, w, mu, s))
+        # analytic lognormal pdf
+        ref = np.exp(-0.5 * ((np.log(grid) - 0.5) / 0.6) ** 2) / (
+            grid * 0.6 * np.sqrt(2 * np.pi)
+        )
+        np.testing.assert_allclose(dens, ref, rtol=0.05, atol=1e-3)
+
+    def test_lgmm1_bounded_support(self):
+        w = [1.0]
+        mu = [0.0]
+        s = [1.0]
+        lo, hi = np.log(0.5), np.log(4.0)
+        x = tpe.LGMM1(w, mu, s, low=lo, high=hi, rng=np.random.default_rng(3), size=(20000,))
+        assert x.min() >= 0.5 - 1e-6 and x.max() <= 4.0 + 1e-6
+
+    def test_qgmm_discrete_probs_sum_to_one(self):
+        w = [0.5, 0.5]
+        mu = [2.0, 6.0]
+        s = [1.0, 1.0]
+        q = 1.0
+        vals = np.arange(0.0, 9.0, q)
+        ll = tpe.GMM1_lpdf(vals, w, mu, s, low=0.0, high=8.0, q=q)
+        total = np.exp(ll).sum()
+        assert abs(total - 1.0) < 0.02
+
+    def test_qgmm_sampler_matches_pmf(self):
+        w = [1.0]
+        mu = [3.0]
+        s = [2.0]
+        q = 1.0
+        rng = np.random.default_rng(4)
+        x = tpe.GMM1(w, mu, s, low=0.0, high=8.0, q=q, rng=rng, size=(40000,))
+        vals, counts = np.unique(x, return_counts=True)
+        freq = counts / counts.sum()
+        pmf = np.exp(tpe.GMM1_lpdf(vals, w, mu, s, low=0.0, high=8.0, q=q))
+        np.testing.assert_allclose(freq, pmf, atol=0.015)
+
+    def test_gmm1_seeded_deterministic(self):
+        w, mu, s = [1.0], [0.0], [1.0]
+        a = tpe.GMM1(w, mu, s, rng=np.random.default_rng(9), size=(10,))
+        b = tpe.GMM1(w, mu, s, rng=np.random.default_rng(9), size=(10,))
+        np.testing.assert_array_equal(a, b)
+
+
+class TestSplit:
+    def test_ap_split_counts(self):
+        tids = np.arange(100)
+        losses = np.arange(100, dtype=float)
+        below = tpe.ap_split_trials(tids, losses, gamma=0.25)
+        # ceil(0.25 * 10) = 3
+        assert below == frozenset([0, 1, 2])
+
+    def test_ap_split_capped_by_lf(self):
+        tids = np.arange(10000)
+        losses = np.random.default_rng(0).standard_normal(10000)
+        below = tpe.ap_split_trials(tids, losses, gamma=0.9, gamma_cap=25)
+        assert len(below) == 25
+
+
+class TestSuggest:
+    def test_startup_uses_random(self):
+        d = domains.get("quadratic1")
+        domain = Domain(d.fn, d.space)
+        trials = Trials()
+        ids = trials.new_trial_ids(1)
+        docs_tpe = tpe.suggest(ids, domain, trials, seed=5)
+        docs_rand = rand.suggest(ids, domain, Trials(), seed=5)
+        assert (
+            docs_tpe[0]["misc"]["vals"]["x"] == docs_rand[0]["misc"]["vals"]["x"]
+        )
+
+    def test_suggest_deterministic(self):
+        d = domains.get("quadratic1")
+        trials = Trials()
+        fmin(
+            d.fn, d.space, algo=rand.suggest, max_evals=25, trials=trials,
+            rstate=np.random.default_rng(0), show_progressbar=False, verbose=False,
+        )
+        domain = Domain(d.fn, d.space)
+        ids = [100]
+        a = tpe.suggest(ids, domain, trials, seed=3)
+        b = tpe.suggest(ids, domain, trials, seed=3)
+        assert a[0]["misc"]["vals"] == b[0]["misc"]["vals"]
+
+    def test_suggest_batch_of_ids(self):
+        d = domains.get("branin")
+        trials = Trials()
+        fmin(
+            d.fn, d.space, algo=rand.suggest, max_evals=25, trials=trials,
+            rstate=np.random.default_rng(0), show_progressbar=False, verbose=False,
+        )
+        domain = Domain(d.fn, d.space)
+        docs = tpe.suggest([100, 101, 102], domain, trials, seed=0)
+        assert len(docs) == 3
+        xs = [doc["misc"]["vals"]["x"][0] for doc in docs]
+        assert len(set(xs)) == 3  # independent candidate draws per id
+
+    def test_conditional_space_active_labels_only(self):
+        d = domains.get("q1_choice")
+        trials = Trials()
+        fmin(
+            d.fn, d.space, algo=rand.suggest, max_evals=30, trials=trials,
+            rstate=np.random.default_rng(0), show_progressbar=False, verbose=False,
+        )
+        domain = Domain(d.fn, d.space)
+        docs = tpe.suggest(list(range(100, 120)), domain, trials, seed=1)
+        for doc in docs:
+            m = doc["misc"]
+            assert (len(m["idxs"]["xl"]) == 1) != (len(m["idxs"]["xr"]) == 1)
+            choice = m["vals"]["mode"][0]
+            if choice == 0:
+                assert len(m["idxs"]["xl"]) == 1
+            else:
+                assert len(m["idxs"]["xr"]) == 1
+
+    def test_partial_config_pattern(self):
+        from functools import partial
+
+        d = domains.get("quadratic1")
+        algo = partial(tpe.suggest, n_startup_jobs=5, n_EI_candidates=50, gamma=0.3)
+        trials = Trials()
+        fmin(
+            d.fn, d.space, algo=algo, max_evals=30, trials=trials,
+            rstate=np.random.default_rng(0), show_progressbar=False, verbose=False,
+        )
+        assert len(trials) == 30
+
+
+@pytest.mark.parametrize(
+    "name", ["quadratic1", "q1_choice", "gauss_wave", "branin", "distractor"]
+)
+def test_tpe_quality_on_domains(name):
+    """Optimization-quality thresholds per domain (the reference's
+    conformance style: best loss below bound after fixed trials)."""
+    d = domains.get(name)
+    trials = Trials()
+    fmin(
+        d.fn,
+        d.space,
+        algo=tpe.suggest,
+        max_evals=d.quality_evals,
+        trials=trials,
+        rstate=np.random.default_rng(123),
+        show_progressbar=False,
+        verbose=False,
+    )
+    best = min(trials.losses())
+    assert best < d.quality_threshold, (name, best, d.quality_threshold)
+
+
+def test_tpe_beats_random_on_distractor():
+    """Guided search must find the narrow global basin more reliably."""
+    d = domains.get("distractor")
+
+    def best_of(algo, seed):
+        trials = Trials()
+        fmin(
+            d.fn, d.space, algo=algo, max_evals=100, trials=trials,
+            rstate=np.random.default_rng(seed), show_progressbar=False, verbose=False,
+        )
+        return min(trials.losses())
+
+    tpe_scores = [best_of(tpe.suggest, s) for s in range(3)]
+    rand_scores = [best_of(rand.suggest, s) for s in range(3)]
+    assert np.mean(tpe_scores) <= np.mean(rand_scores) + 0.05
