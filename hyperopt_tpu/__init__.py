@@ -46,7 +46,7 @@ from .fmin import (
     generate_trials_to_calculate,
     space_eval,
 )
-from .algos import rand
+from .algos import anneal, criteria, mix, rand, tpe
 from .early_stop import no_progress_loss
 
 __version__ = "0.1.0"
@@ -74,13 +74,17 @@ __all__ = [
     "STATUS_STRINGS",
     "STATUS_SUSPENDED",
     "Trials",
+    "anneal",
+    "criteria",
     "fmin",
     "fmin_pass_expr_memo_ctrl",
     "generate_trials_to_calculate",
     "hp",
+    "mix",
     "no_progress_loss",
     "pyll",
     "rand",
     "space_eval",
+    "tpe",
     "trials_from_docs",
 ]

@@ -1,0 +1,83 @@
+"""Annealing tests (reference parity: hyperopt/tests/test_anneal.py):
+convergence-quality thresholds per domain + shrinkage behavior.
+"""
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Domain, Trials, fmin
+from hyperopt_tpu.algos import anneal, rand
+from hyperopt_tpu.models import domains
+
+
+@pytest.mark.parametrize(
+    "name", ["quadratic1", "gauss_wave", "branin", "hartmann6", "q1_choice"]
+)
+def test_anneal_quality_on_domains(name):
+    d = domains.get(name)
+    trials = Trials()
+    fmin(
+        d.fn,
+        d.space,
+        algo=anneal.suggest,
+        max_evals=d.quality_evals,
+        trials=trials,
+        rstate=np.random.default_rng(7),
+        show_progressbar=False,
+        verbose=False,
+    )
+    best = min(trials.losses())
+    assert best < d.quality_threshold, (name, best, d.quality_threshold)
+
+
+def test_anneal_shrinks_toward_incumbent():
+    d = domains.get("quadratic1")
+    trials = Trials()
+    fmin(
+        d.fn, d.space, algo=anneal.suggest, max_evals=120, trials=trials,
+        rstate=np.random.default_rng(0), show_progressbar=False, verbose=False,
+    )
+    xs = np.array([m["vals"]["x"][0] for m in trials.miscs])
+    # late proposals concentrate near the optimum (x=3) vs early ones
+    early_spread = np.std(xs[:30])
+    late_spread = np.std(xs[-30:])
+    assert late_spread < early_spread
+    assert abs(np.mean(xs[-30:]) - 3.0) < 1.0
+
+
+def test_anneal_deterministic():
+    d = domains.get("branin")
+    trials = Trials()
+    fmin(
+        d.fn, d.space, algo=rand.suggest, max_evals=10, trials=trials,
+        rstate=np.random.default_rng(0), show_progressbar=False, verbose=False,
+    )
+    domain = Domain(d.fn, d.space)
+    a = anneal.suggest([100], domain, trials, seed=3)
+    b = anneal.suggest([100], domain, trials, seed=3)
+    assert a[0]["misc"]["vals"] == b[0]["misc"]["vals"]
+
+
+def test_anneal_empty_history_uses_prior():
+    d = domains.get("many_dists")
+    domain = Domain(d.fn, d.space)
+    trials = Trials()
+    docs = anneal.suggest([0, 1, 2], domain, trials, seed=0)
+    assert len(docs) == 3
+    for doc in docs:
+        v = doc["misc"]["vals"]
+        assert 4 <= v["c"][0] <= 7
+        assert v["a"][0] in (0, 1, 2)
+
+
+def test_anneal_respects_bounds():
+    d = domains.get("branin")
+    trials = Trials()
+    fmin(
+        d.fn, d.space, algo=anneal.suggest, max_evals=150, trials=trials,
+        rstate=np.random.default_rng(1), show_progressbar=False, verbose=False,
+    )
+    xs = [m["vals"]["x"][0] for m in trials.miscs]
+    ys = [m["vals"]["y"][0] for m in trials.miscs]
+    assert min(xs) >= -5.0 and max(xs) <= 10.0
+    assert min(ys) >= 0.0 and max(ys) <= 15.0

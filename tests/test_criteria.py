@@ -1,0 +1,45 @@
+"""Criteria numerics vs closed forms (reference parity: test_criteria.py)."""
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu.algos import criteria
+
+
+def test_ei_empirical():
+    samples = np.array([0.0, 1.0, 2.0, 3.0])
+    assert criteria.EI_empirical(samples, 1.0) == pytest.approx((0 + 0 + 1 + 2) / 4)
+    assert criteria.EI_empirical(samples, 10.0) == 0.0
+
+
+def test_ei_gaussian_matches_monte_carlo():
+    rng = np.random.default_rng(0)
+    mean, var, thresh = 1.0, 4.0, 2.0
+    samples = rng.normal(mean, np.sqrt(var), 2_000_000)
+    mc = np.maximum(samples - thresh, 0).mean()
+    assert criteria.EI_gaussian(mean, var, thresh) == pytest.approx(mc, rel=0.01)
+
+
+def test_ei_gaussian_far_above_thresh():
+    # when mean >> thresh, EI -> mean - thresh
+    assert criteria.EI_gaussian(10.0, 0.01, 0.0) == pytest.approx(10.0, rel=1e-3)
+
+
+def test_log_ei_consistent_with_ei():
+    for mean, var, thresh in [(0.0, 1.0, 1.0), (2.0, 0.5, 1.0), (-1.0, 2.0, 3.0)]:
+        assert criteria.logEI_gaussian(mean, var, thresh) == pytest.approx(
+            np.log(criteria.EI_gaussian(mean, var, thresh)), rel=1e-6
+        )
+
+
+def test_log_ei_asymptotic_branch_continuous():
+    # across the z = -34 switch the function must be finite and decreasing
+    var = 1.0
+    vals = [criteria.logEI_gaussian(0.0, var, t) for t in (33.0, 34.0, 35.0, 40.0)]
+    assert all(np.isfinite(v) for v in vals)
+    assert all(a > b for a, b in zip(vals, vals[1:]))
+
+
+def test_ucb():
+    assert criteria.UCB(1.0, 4.0, 2.0) == 5.0
+    assert criteria.UCB(1.0, 4.0, 0.0) == 1.0

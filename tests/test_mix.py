@@ -1,0 +1,56 @@
+"""Mixture-of-algorithms tests (reference parity: hyperopt/mix.py)."""
+
+from functools import partial
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, fmin
+from hyperopt_tpu.algos import anneal, mix, rand, tpe
+from hyperopt_tpu.models import domains
+
+
+def test_mix_runs_end_to_end():
+    d = domains.get("quadratic1")
+    algo = partial(
+        mix.suggest,
+        p_suggest=[(0.3, rand.suggest), (0.3, anneal.suggest), (0.4, tpe.suggest)],
+    )
+    trials = Trials()
+    fmin(
+        d.fn, d.space, algo=algo, max_evals=40, trials=trials,
+        rstate=np.random.default_rng(0), show_progressbar=False, verbose=False,
+    )
+    assert len(trials) == 40
+    assert min(trials.losses()) < 1.0
+
+
+def test_mix_probabilities_respected():
+    calls = {"a": 0, "b": 0}
+
+    def algo_a(new_ids, domain, trials, seed):
+        calls["a"] += 1
+        return rand.suggest(new_ids, domain, trials, seed)
+
+    def algo_b(new_ids, domain, trials, seed):
+        calls["b"] += 1
+        return rand.suggest(new_ids, domain, trials, seed)
+
+    d = domains.get("quadratic1")
+    algo = partial(mix.suggest, p_suggest=[(0.85, algo_a), (0.15, algo_b)])
+    fmin(
+        d.fn, d.space, algo=algo, max_evals=100,
+        rstate=np.random.default_rng(0), show_progressbar=False, verbose=False,
+    )
+    assert calls["a"] > calls["b"]
+    assert calls["a"] + calls["b"] == 100
+
+
+def test_mix_invalid_probs():
+    d = domains.get("quadratic1")
+    algo = partial(mix.suggest, p_suggest=[(0.5, rand.suggest), (0.2, rand.suggest)])
+    with pytest.raises(ValueError):
+        fmin(
+            d.fn, d.space, algo=algo, max_evals=2,
+            rstate=np.random.default_rng(0), show_progressbar=False, verbose=False,
+        )
