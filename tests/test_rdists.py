@@ -1,0 +1,107 @@
+"""KS / total-variation conformance of samplers vs exact distributions
+(reference parity: hyperopt/tests/test_rdists.py).
+
+Both sampling paths are pinned to the same closed forms: the numpy
+stochastic scope symbols AND the compiled JAX sampler.
+"""
+
+import numpy as np
+import pytest
+from scipy import stats
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.rdists import (
+    loguniform_gen,
+    lognorm_tx_gen,
+    qloguniform_gen,
+    qlognormal_gen,
+    qnormal_gen,
+    quniform_gen,
+)
+from hyperopt_tpu.vectorize import CompiledSpace
+
+N = 20000
+
+
+def compiled_samples(node, n=N, seed=0):
+    cs = CompiledSpace({"v": node})
+    vals, _ = cs.sample_batch(seed, n)
+    return np.asarray(vals["v"], dtype=float)
+
+
+class TestContinuousKS:
+    def test_uniform(self):
+        x = compiled_samples(hp.uniform("v", -2.0, 5.0))
+        assert stats.kstest(x, stats.uniform(loc=-2, scale=7).cdf).pvalue > 0.01
+
+    def test_loguniform(self):
+        low, high = np.log(1e-3), np.log(1e2)
+        x = compiled_samples(hp.loguniform("v", low, high))
+        assert stats.kstest(x, loguniform_gen(low, high).cdf).pvalue > 0.01
+
+    def test_normal(self):
+        x = compiled_samples(hp.normal("v", 3.0, 2.5))
+        assert stats.kstest(x, stats.norm(loc=3, scale=2.5).cdf).pvalue > 0.01
+
+    def test_lognormal(self):
+        x = compiled_samples(hp.lognormal("v", 0.5, 0.8))
+        assert stats.kstest(x, lognorm_tx_gen(0.5, 0.8).cdf).pvalue > 0.01
+
+
+class TestQuantizedTV:
+    """Total-variation distance between sampled freqs and the exact pmf."""
+
+    def _tv_check(self, samples, dist, tol=0.02):
+        vals, counts = np.unique(samples, return_counts=True)
+        freq = counts / counts.sum()
+        pmf = dist.pmf(vals)
+        tv = 0.5 * np.abs(freq - pmf).sum() + 0.5 * max(0.0, 1.0 - pmf.sum())
+        assert tv < tol, tv
+
+    def test_quniform(self):
+        x = compiled_samples(hp.quniform("v", 0.0, 10.0, 2.0))
+        self._tv_check(x, quniform_gen(0.0, 10.0, 2.0))
+
+    def test_qnormal(self):
+        x = compiled_samples(hp.qnormal("v", 0.0, 3.0, 1.0))
+        self._tv_check(x, qnormal_gen(0.0, 3.0, 1.0))
+
+    def test_qloguniform(self):
+        x = compiled_samples(hp.qloguniform("v", np.log(1.0), np.log(50.0), 5.0))
+        self._tv_check(x, qloguniform_gen(np.log(1.0), np.log(50.0), 5.0))
+
+    def test_qlognormal(self):
+        x = compiled_samples(hp.qlognormal("v", 1.0, 0.7, 1.0))
+        self._tv_check(x, qlognormal_gen(1.0, 0.7, 1.0))
+
+    def test_numpy_path_agrees_too(self):
+        from hyperopt_tpu.pyll import sample, scope
+
+        x = sample(scope.qnormal(0.0, 3.0, 1.0, size=(N,)), np.random.default_rng(0))
+        self._tv_check(x, qnormal_gen(0.0, 3.0, 1.0))
+
+
+class TestExactForms:
+    def test_loguniform_pdf_integrates(self):
+        g = loguniform_gen(np.log(0.1), np.log(10.0))
+        grid = np.linspace(0.1, 10.0, 20001)
+        assert abs(np.trapezoid(g.pdf(grid), grid) - 1.0) < 1e-3
+
+    def test_quniform_pmf_sums(self):
+        g = quniform_gen(0.0, 10.0, 0.5)
+        assert abs(g.pmf(g.support()).sum() - 1.0) < 1e-9
+
+    def test_qnormal_pmf_sums(self):
+        g = qnormal_gen(1.0, 2.0, 0.5)
+        grid = np.arange(-20.0, 22.0, 0.5)
+        assert abs(g.pmf(grid).sum() - 1.0) < 1e-6
+
+    def test_pmf_zero_off_grid(self):
+        g = quniform_gen(0.0, 10.0, 1.0)
+        assert g.pmf(np.array([0.5, 1.3])).sum() == 0.0
+
+    def test_rvs_seeded(self):
+        g = qnormal_gen(0.0, 1.0, 0.5)
+        a = g.rvs(size=10, random_state=3)
+        b = g.rvs(size=10, random_state=3)
+        np.testing.assert_array_equal(a, b)
