@@ -97,7 +97,7 @@ class FMinIter:
         rstate,
         asynchronous=None,
         max_queue_len=1,
-        poll_interval_secs=1.0,
+        poll_interval_secs=None,
         max_evals=sys.maxsize,
         timeout=None,
         loss_threshold=None,
@@ -113,6 +113,10 @@ class FMinIter:
             self.asynchronous = trials.asynchronous
         else:
             self.asynchronous = asynchronous
+        if poll_interval_secs is None:
+            # in-process async backends (JaxTrials) advertise a fast poll;
+            # remote queues (FileTrials) a slower one
+            poll_interval_secs = getattr(trials, "poll_interval_secs", 1.0)
         self.poll_interval_secs = poll_interval_secs
         self.max_queue_len = max_queue_len
         self.max_evals = max_evals
@@ -128,9 +132,17 @@ class FMinIter:
 
         if self.asynchronous:
             if "FMinIter_Domain" not in trials.attachments:
-                msg = "TID means trial id"
-                logger.info("domain attachment: %s", msg)
-                trials.attachments["FMinIter_Domain"] = pickle.dumps(domain)
+                # out-of-process workers (FileTrials) unpickle the domain
+                # from this attachment; in-process backends (JaxTrials)
+                # don't need it, so unpicklable objectives are fine there
+                try:
+                    trials.attachments["FMinIter_Domain"] = pickle.dumps(domain)
+                except (pickle.PicklingError, AttributeError, TypeError) as e:
+                    logger.info(
+                        "domain not picklable (%s); out-of-process workers "
+                        "will not be able to fetch it",
+                        e,
+                    )
 
     def serial_evaluate(self, N=-1):
         for trial in self.trials._dynamic_trials:

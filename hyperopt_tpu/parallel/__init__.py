@@ -1,0 +1,23 @@
+"""Parallel & distributed execution backends.
+
+The reference's parallelism inventory (SURVEY.md §2, parallelism table):
+task/trial parallelism via ``SparkTrials`` (driver threads + one-task
+jobs) and ``MongoTrials`` (durable poll queue + atomic reservation).
+
+TPU-native equivalents:
+- :mod:`sharding` — mesh construction + shard_map'd TPE scoring: the
+  history/component axis is sharded over ``sp`` (this framework's
+  sequence-parallel analog: blockwise log-sum-exp with ``psum`` over ICI)
+  and the candidate axis over ``dp``.
+- :mod:`jax_trials` — ``JaxTrials``: batched asynchronous trial execution
+  (SparkTrials analog; thread-pool dispatcher + timeout→cancel) plus
+  on-device vectorized batch evaluation for jittable objectives.
+- :mod:`file_trials` — ``FileTrials``: durable filesystem-backed work queue
+  with atomic reservation (MongoTrials analog) + a worker CLI.
+- :mod:`distributed` — ``jax.distributed`` multi-host initialization
+  helpers (ICI intra-slice, DCN inter-slice).
+"""
+
+from . import sharding
+
+__all__ = ["sharding"]

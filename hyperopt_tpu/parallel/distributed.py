@@ -1,0 +1,68 @@
+"""Multi-host initialization (ICI intra-slice, DCN inter-slice).
+
+Reference parity (SURVEY.md §5 "distributed communication backend"): the
+reference's substrate is MongoDB polling + Spark RPC.  The TPU-native
+numeric plane is ``jax.distributed`` + XLA collectives: every host joins
+one runtime, device collectives ride ICI within a slice and DCN across
+slices, and the *control* plane (trial queue for black-box objectives)
+stays host-side (:mod:`hyperopt_tpu.parallel.file_trials` — durable and
+poll-based like Mongo, on a shared filesystem).
+
+Single-controller convention: host 0 runs the fmin driver; other hosts run
+workers (`python -m hyperopt_tpu.parallel.worker`) against the shared
+queue, or participate purely as mesh devices for sharded suggest.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+
+def initialize(
+    coordinator_address=None, num_processes=None, process_id=None, **kwargs
+):
+    """Join the multi-host JAX runtime (no-op when single-process).
+
+    Thin, env-var-aware wrapper over ``jax.distributed.initialize``: with
+    no arguments, TPU pod metadata auto-configures everything; explicit
+    arguments are for CPU/GPU clusters or tests.
+    """
+    import jax
+
+    if num_processes in (None, 1) and coordinator_address is None and (
+        os.environ.get("JAX_COORDINATOR_ADDRESS") is None
+    ):
+        # single-host: nothing to initialize, mesh uses local devices
+        logger.info("distributed.initialize: single-host, skipping")
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
+    logger.info(
+        "distributed.initialize: process %d/%d ready",
+        jax.process_index(),
+        jax.process_count(),
+    )
+    return True
+
+
+def is_coordinator():
+    import jax
+
+    return jax.process_index() == 0
+
+
+def global_mesh(axis_names=("dp", "sp"), shape=None):
+    """Mesh over ALL devices in the distributed runtime (every host must
+    call this with the same arguments — standard SPMD contract)."""
+    from .sharding import default_mesh
+
+    import jax
+
+    return default_mesh(axis_names=axis_names, shape=shape, devices=jax.devices())

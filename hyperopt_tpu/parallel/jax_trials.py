@@ -1,0 +1,308 @@
+"""JaxTrials: batched asynchronous trial execution.
+
+Reference parity (SURVEY.md §2 #18): ``hyperopt/spark.py`` —
+``SparkTrials(Trials)`` (`parallelism`, `timeout`, `loss_threshold`,
+concurrency cap ~L30-200) and ``_SparkFMinState`` (driver-side dispatcher,
+per-trial tasks, job cancellation on timeout → ``JOB_STATE_CANCEL``,
+``_begin/_finish_trial_run`` ~L200-600).
+
+TPU-native redesign: instead of JVM executors there are two execution
+planes —
+- **host plane** (arbitrary Python objectives): a thread-pool dispatcher
+  claims JOB_STATE_NEW docs, runs ``domain.evaluate`` concurrently, and
+  enforces per-trial timeouts by cancel-marking (the Spark job-group
+  cancel analog);
+- **device plane** (jittable objectives): pass ``device_fn=`` — a whole
+  queue batch is evaluated as ONE vmapped XLA program with the batch axis
+  sharded across the mesh's ``dp`` axis
+  (:func:`hyperopt_tpu.parallel.sharding.make_sharded_batch_eval`) —
+  SparkTrials' "1 task per trial" becomes "1 program per batch".
+
+``fmin`` drives both through the same asynchronous enqueue/poll loop it
+uses for every async backend.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from timeit import default_timer as timer
+
+import numpy as np
+
+from ..base import (
+    JOB_STATE_CANCEL,
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    JOB_STATE_RUNNING,
+    STATUS_OK,
+    Ctrl,
+    Domain,
+    Trials,
+    spec_from_misc,
+    validate_loss_threshold,
+    validate_timeout,
+)
+from ..utils import coarse_utcnow
+
+logger = logging.getLogger(__name__)
+
+MAX_CONCURRENT_JOBS_ALLOWED = 128
+
+
+class JaxTrials(Trials):
+    """Trials store executing trials in parallel on the local host/devices.
+
+    Drop-in ``Trials`` subclass (the plugin boundary): pass to
+    ``fmin(trials=JaxTrials(parallelism=8))``.
+    """
+
+    asynchronous = True
+    poll_interval_secs = 0.02  # in-process dispatcher: poll fast
+
+    def __init__(
+        self,
+        parallelism=None,
+        timeout=None,
+        loss_threshold=None,
+        device_fn=None,
+        mesh=None,
+        exp_key=None,
+        refresh=True,
+    ):
+        super().__init__(exp_key=exp_key, refresh=refresh)
+        validate_timeout(timeout)
+        validate_loss_threshold(loss_threshold)
+        if parallelism is None:
+            import jax
+
+            parallelism = max(1, len(jax.devices()))
+        if parallelism > MAX_CONCURRENT_JOBS_ALLOWED:
+            logger.warning(
+                "parallelism %d capped at %d", parallelism, MAX_CONCURRENT_JOBS_ALLOWED
+            )
+            parallelism = MAX_CONCURRENT_JOBS_ALLOWED
+        self.parallelism = parallelism
+        self.timeout = timeout
+        self.loss_threshold = loss_threshold
+        self.device_fn = device_fn
+        self.mesh = mesh
+        self._fmin_state = None
+
+    def fmin(
+        self,
+        fn,
+        space,
+        algo=None,
+        max_evals=None,
+        timeout=None,
+        loss_threshold=None,
+        max_queue_len=None,
+        rstate=None,
+        verbose=False,
+        pass_expr_memo_ctrl=None,
+        catch_eval_exceptions=False,
+        return_argmin=True,
+        show_progressbar=True,
+        early_stop_fn=None,
+        trials_save_file="",
+        points_to_evaluate=None,
+    ):
+        from ..fmin import fmin as _fmin
+
+        assert (
+            not pass_expr_memo_ctrl
+        ), "JaxTrials executes objectives outside the driver; plain configs only"
+        timeout = timeout if timeout is not None else self.timeout
+        loss_threshold = (
+            loss_threshold if loss_threshold is not None else self.loss_threshold
+        )
+        state = _JaxFMinState(
+            fn,
+            space,
+            self,
+            parallelism=self.parallelism,
+            trial_timeout=self.timeout,
+            device_fn=self.device_fn,
+            mesh=self.mesh,
+            catch_eval_exceptions=catch_eval_exceptions,
+        )
+        self._fmin_state = state
+        state.start()
+        try:
+            return _fmin(
+                fn,
+                space,
+                algo=algo,
+                max_evals=max_evals,
+                timeout=timeout,
+                loss_threshold=loss_threshold,
+                trials=self,
+                rstate=rstate,
+                verbose=verbose,
+                # the queue must stay at least `parallelism` deep or the
+                # dispatcher starves (top-level fmin defaults this to 1)
+                max_queue_len=max(max_queue_len or 1, self.parallelism),
+                allow_trials_fmin=False,
+                pass_expr_memo_ctrl=pass_expr_memo_ctrl,
+                catch_eval_exceptions=catch_eval_exceptions,
+                return_argmin=return_argmin,
+                show_progressbar=show_progressbar,
+                early_stop_fn=early_stop_fn,
+                trials_save_file=trials_save_file,
+                points_to_evaluate=points_to_evaluate,
+            )
+        finally:
+            state.stop()
+            self._fmin_state = None
+
+
+class _JaxFMinState:
+    """Driver-side dispatcher: claims NEW trials, runs them concurrently."""
+
+    POLL_SECS = 0.05
+
+    def __init__(
+        self,
+        fn,
+        space,
+        trials,
+        parallelism,
+        trial_timeout=None,
+        device_fn=None,
+        mesh=None,
+        catch_eval_exceptions=False,
+    ):
+        self.trials = trials
+        self.domain = Domain(fn, space)
+        self.parallelism = parallelism
+        self.trial_timeout = trial_timeout
+        self.catch_eval_exceptions = catch_eval_exceptions
+        self._device_eval = None
+        if device_fn is not None:
+            from .sharding import default_mesh, make_sharded_batch_eval
+
+            mesh = mesh or default_mesh()
+            self._device_eval = make_sharded_batch_eval(mesh, device_fn)
+            self._mesh = mesh
+        self._stop = threading.Event()
+        self._thread = None
+        self._pool = None
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self):
+        self._pool = ThreadPoolExecutor(max_workers=self.parallelism)
+        self._thread = threading.Thread(target=self._dispatch_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+
+    # -- dispatch ------------------------------------------------------
+    def _claim_new(self):
+        claimed = []
+        for trial in self.trials._dynamic_trials:
+            if trial["state"] == JOB_STATE_NEW:
+                trial["state"] = JOB_STATE_RUNNING
+                now = coarse_utcnow()
+                trial["book_time"] = now
+                trial["refresh_time"] = now
+                trial["owner"] = "jax_trials"
+                claimed.append(trial)
+        return claimed
+
+    def _dispatch_loop(self):
+        while not self._stop.is_set():
+            claimed = self._claim_new()
+            if claimed:
+                if self._device_eval is not None:
+                    self._run_batch_on_device(claimed)
+                else:
+                    for trial in claimed:
+                        self._pool.submit(self._run_one, trial)
+            time.sleep(self.POLL_SECS)
+
+    # -- host plane ----------------------------------------------------
+    def _run_one(self, trial):
+        spec = spec_from_misc(trial["misc"])
+        ctrl = Ctrl(self.trials, current_trial=trial)
+        start = timer()
+        try:
+            if self.trial_timeout is not None:
+                result_box = {}
+
+                def target():
+                    try:
+                        result_box["result"] = self.domain.evaluate(spec, ctrl)
+                    except BaseException as e:  # propagated below
+                        result_box["error"] = e
+
+                t = threading.Thread(target=target, daemon=True)
+                t.start()
+                t.join(self.trial_timeout)
+                if t.is_alive():
+                    trial["state"] = JOB_STATE_CANCEL
+                    trial["refresh_time"] = coarse_utcnow()
+                    logger.warning(
+                        "trial %s cancelled after %.1fs timeout",
+                        trial["tid"],
+                        self.trial_timeout,
+                    )
+                    return
+                if "error" in result_box:
+                    raise result_box["error"]
+                result = result_box["result"]
+            else:
+                result = self.domain.evaluate(spec, ctrl)
+        except Exception as e:
+            logger.error("trial %s exception: %s", trial["tid"], e)
+            trial["state"] = JOB_STATE_ERROR
+            trial["misc"]["error"] = (str(type(e)), str(e))
+            trial["refresh_time"] = coarse_utcnow()
+            return
+        trial["result"] = result
+        trial["state"] = JOB_STATE_DONE
+        trial["refresh_time"] = coarse_utcnow()
+        logger.debug("trial %s done in %.3fs", trial["tid"], timer() - start)
+
+    # -- device plane --------------------------------------------------
+    def _run_batch_on_device(self, trials_batch):
+        import jax.numpy as jnp
+
+        specs = [spec_from_misc(t["misc"]) for t in trials_batch]
+        labels = sorted({k for s in specs for k in s})
+        if any(set(s) != set(labels) for s in specs):
+            # conditional spaces have ragged configs; device plane needs
+            # dense configs -> fall back to host threads
+            for trial in trials_batch:
+                self._pool.submit(self._run_one, trial)
+            return
+        # pad the batch to the mesh's dp extent for even sharding
+        dp = int(self._mesh.shape.get("dp", 1))
+        b = len(specs)
+        padded = b if b % dp == 0 else b + (dp - b % dp)
+        batch = {
+            k: np.asarray([s[k] for s in specs] + [specs[-1][k]] * (padded - b))
+            for k in labels
+        }
+        try:
+            losses = np.asarray(self._device_eval(batch))[:b]
+        except Exception as e:
+            logger.error("device batch failed: %s", e)
+            for trial in trials_batch:
+                trial["state"] = JOB_STATE_ERROR
+                trial["misc"]["error"] = (str(type(e)), str(e))
+                trial["refresh_time"] = coarse_utcnow()
+            return
+        now = coarse_utcnow()
+        for trial, loss in zip(trials_batch, losses):
+            trial["result"] = {"loss": float(loss), "status": STATUS_OK}
+            trial["state"] = JOB_STATE_DONE
+            trial["refresh_time"] = now

@@ -1,0 +1,139 @@
+"""Device meshes + sharded TPE scoring (the SP/DP compute plane).
+
+The reference has no tensor parallelism to mirror (SURVEY.md §2); its
+scaling axis is *trial history length* inside TPE, which it handles by
+truncation (``linear_forgetting=25`` drops old trials).  The TPU-native
+answer (SURVEY.md §5 "long-context"): keep the FULL history, shard the
+mixture-component axis across the mesh (``sp`` — the sequence-parallel
+analog), and do blockwise log-sum-exp with ``psum``/``pmax`` collectives
+over ICI; candidates shard over ``dp``.  This is the same blockwise-
+softmax-over-shards pattern as ring attention, minus the ring: component
+blocks are resident per-device, only O(C) scalars cross the interconnect.
+
+Everything here is pure ``shard_map`` + collectives — XLA inserts the ICI
+communication; nothing is hand-scheduled.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_SQRT_2PI = 2.5066282746310002
+EPS = 1e-12
+
+
+def default_mesh(axis_names=("dp", "sp"), shape=None, devices=None):
+    """Build a 2-D device mesh: ``dp`` (candidates/batch) × ``sp`` (history).
+
+    With n devices and no explicit shape, uses (n // sp_size, sp_size) with
+    the largest power-of-two ``sp`` ≤ √n — history sharding is the scaling
+    axis, candidate sharding the throughput axis.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    if shape is None:
+        sp = 1
+        while sp * 2 <= int(np.sqrt(n)) + 1 and (n % (sp * 2)) == 0:
+            sp *= 2
+        dp = n // sp
+        shape = (dp, sp)
+    assert shape[0] * shape[1] == n, (shape, n)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, axis_names)
+
+
+def _local_logsumexp_block(comp_ll, axis_name):
+    """Distributed log-sum-exp over the sharded component axis."""
+    m_loc = jnp.max(comp_ll, axis=1)
+    m_glob = jax.lax.pmax(m_loc, axis_name)
+    s_loc = jnp.sum(jnp.exp(comp_ll - m_glob[:, None]), axis=1)
+    s_glob = jax.lax.psum(s_loc, axis_name)
+    return m_glob + jnp.log(jnp.maximum(s_glob, EPS))
+
+
+def _ndtr(z):
+    return jax.scipy.special.ndtr(jnp.clip(z, -40.0, 40.0))
+
+
+def make_sharded_score(mesh: Mesh, dp: str = "dp", sp: str = "sp"):
+    """Jitted sharded l(x)/g(x) scorer.
+
+    ``cand`` is sharded over ``dp``; both mixtures' (w, mu, sigma) over
+    ``sp``.  Returns per-candidate ``log l − log g`` (sharded over dp).
+    Semantics match :func:`hyperopt_tpu.ops.gmm.gmm_lpdf` (continuous).
+    """
+
+    def _lpdf_block(cand, w, mu, sigma, low, high):
+        sigma = jnp.maximum(sigma, EPS)
+        logw = jnp.log(jnp.maximum(w, EPS))
+        comp_ll = (
+            -0.5 * ((cand[:, None] - mu[None, :]) / sigma[None, :]) ** 2
+            - jnp.log(sigma * _SQRT_2PI)[None, :]
+            + logw[None, :]
+        )
+        ll = _local_logsumexp_block(comp_ll, sp)
+        # in-bounds mixture mass, reduced over the sharded component axis
+        p_acc_loc = jnp.sum(
+            w * (_ndtr((high - mu) / sigma) - _ndtr((low - mu) / sigma))
+        )
+        p_acc = jax.lax.psum(p_acc_loc, sp)
+        in_b = (cand >= low) & (cand < high)
+        return jnp.where(in_b, ll - jnp.log(jnp.maximum(p_acc, EPS)), -jnp.inf)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(dp),          # candidates
+            P(sp), P(sp), P(sp),  # below mixture
+            P(sp), P(sp), P(sp),  # above mixture
+            P(), P(),       # bounds (replicated)
+        ),
+        out_specs=P(dp),
+    )
+    def score(cand, wb, mb, sb, wa, ma, sa, low, high):
+        ll_b = _lpdf_block(cand, wb, mb, sb, low, high)
+        ll_a = _lpdf_block(cand, wa, ma, sa, low, high)
+        return ll_b - ll_a
+
+    return jax.jit(score)
+
+
+def make_sharded_batch_eval(mesh: Mesh, fn, dp: str = "dp"):
+    """Vectorized on-device objective evaluation, batch sharded over dp.
+
+    ``fn`` is a jittable per-config objective taking a dict of scalars;
+    the returned callable evaluates a whole batch {label: [B]} with the
+    batch axis laid out across the mesh's ``dp`` axis (the SparkTrials-
+    executor analog, minus the serialization: one XLA program, B lanes).
+    """
+    batch_spec = P(dp)
+
+    vf = jax.vmap(fn)
+
+    def run(batch):
+        shardings = {k: NamedSharding(mesh, batch_spec) for k in batch}
+        placed = {
+            k: jax.device_put(jnp.asarray(v), shardings[k]) for k, v in batch.items()
+        }
+        return jax.jit(vf)(placed)
+
+    return run
+
+
+def pad_mixture(w, mu, sigma, total):
+    """Pad mixture arrays to ``total`` (weight-0 tail) for even sharding."""
+    k = len(w)
+    assert total >= k
+    wp = np.zeros(total, np.float32)
+    mp = np.zeros(total, np.float32)
+    sp_ = np.ones(total, np.float32)
+    wp[:k], mp[:k], sp_[:k] = w, mu, sigma
+    return wp, mp, sp_
