@@ -48,6 +48,7 @@ from .fmin import (
 )
 from .algos import anneal, criteria, mix, rand, tpe
 from .early_stop import no_progress_loss
+from .parallel import FileTrials, JaxTrials
 
 __version__ = "0.1.0"
 
@@ -73,6 +74,8 @@ __all__ = [
     "STATUS_RUNNING",
     "STATUS_STRINGS",
     "STATUS_SUSPENDED",
+    "FileTrials",
+    "JaxTrials",
     "Trials",
     "anneal",
     "criteria",

@@ -18,6 +18,8 @@ TPU-native equivalents:
   helpers (ICI intra-slice, DCN inter-slice).
 """
 
-from . import sharding
+from . import distributed, sharding
+from .file_trials import FileTrials
+from .jax_trials import JaxTrials
 
-__all__ = ["sharding"]
+__all__ = ["FileTrials", "JaxTrials", "distributed", "sharding"]

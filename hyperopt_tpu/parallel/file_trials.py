@@ -1,0 +1,320 @@
+"""FileTrials: durable filesystem work queue for multi-worker fmin.
+
+Reference parity (SURVEY.md §2 #17): ``hyperopt/mongoexp.py`` —
+``MongoJobs`` (jobs collection + **atomic ``reserve`` via
+``find_one_and_update`` owner-stamping** ~L160-500), ``MongoTrials(Trials)``
+(~L500-750), ``MongoCtrl`` (~L750-800).
+
+TPU-native redesign: TPU pods share a filesystem (NFS/GCS-fuse), not a
+MongoDB deployment, so the durable queue is a directory:
+
+    <queue>/trials/<tid>.json     one JSON doc per trial (atomic replace)
+    <queue>/locks/<tid>.lock      reservation: O_CREAT|O_EXCL exclusive
+                                  create IS the mutual-exclusion primitive
+                                  (the find_one_and_update analog)
+    <queue>/attachments/<key>     blob store (GridFS analog) — including
+                                  the pickled Domain under
+                                  'FMinIter_Domain'
+    <queue>/ids.counter           monotonic trial-id allocator (lock-file
+                                  protected)
+
+Durability semantics match Mongo: re-run fmin with the same queue dir (and
+exp_key) to resume; workers are stateless and restartable at any time; a
+reserved-but-dead worker's job keeps its lock (the reference's known
+behavior — ``owner`` stays set) unless ``requeue_stale`` is called.
+"""
+
+from __future__ import annotations
+
+import datetime
+import glob
+import json
+import logging
+import os
+import pickle
+import socket
+import time
+from collections.abc import MutableMapping
+
+from ..base import (
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    JOB_STATE_RUNNING,
+    Ctrl,
+    Trials,
+)
+from ..utils import coarse_utcnow
+
+logger = logging.getLogger(__name__)
+
+_DT_KEY = "$datetime"
+
+
+def _json_default(o):
+    if isinstance(o, datetime.datetime):
+        return {_DT_KEY: o.isoformat()}
+    if isinstance(o, bytes):
+        return {"$bytes": o.hex()}
+    if isinstance(o, tuple):
+        return list(o)
+    raise TypeError(o)
+
+
+def _json_object_hook(d):
+    if _DT_KEY in d and len(d) == 1:
+        return datetime.datetime.fromisoformat(d[_DT_KEY])
+    if "$bytes" in d and len(d) == 1:
+        return bytes.fromhex(d["$bytes"])
+    return d
+
+
+def _atomic_write(path, data: bytes):
+    tmp = f"{path}.tmp.{os.getpid()}.{time.monotonic_ns()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _write_doc(path, doc):
+    _atomic_write(
+        path, json.dumps(doc, default=_json_default, sort_keys=True).encode()
+    )
+
+
+def _read_doc(path):
+    for _ in range(5):
+        try:
+            with open(path, "rb") as f:
+                return json.loads(f.read().decode(), object_hook=_json_object_hook)
+        except (json.JSONDecodeError, FileNotFoundError):
+            time.sleep(0.01)  # racing an atomic replace; retry
+    return None
+
+
+class FileJobs:
+    """Low-level queue operations (the MongoJobs analog)."""
+
+    def __init__(self, root):
+        self.root = os.path.abspath(root)
+        for sub in ("trials", "locks", "attachments"):
+            os.makedirs(os.path.join(self.root, sub), exist_ok=True)
+
+    # -- paths ---------------------------------------------------------
+    def trial_path(self, tid):
+        return os.path.join(self.root, "trials", f"{int(tid):012d}.json")
+
+    def lock_path(self, tid):
+        return os.path.join(self.root, "locks", f"{int(tid):012d}.lock")
+
+    def attachment_path(self, key):
+        safe = key.replace("/", "_").replace(":", "_")
+        return os.path.join(self.root, "attachments", safe)
+
+    # -- id allocation --------------------------------------------------
+    def new_trial_ids(self, n):
+        counter = os.path.join(self.root, "ids.counter")
+        lock = counter + ".lock"
+        deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+                break
+            except FileExistsError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"id-counter lock stuck: {lock}")
+                time.sleep(0.01)
+        try:
+            start = 0
+            if os.path.exists(counter):
+                with open(counter) as f:
+                    start = int(f.read().strip() or 0)
+            with open(counter, "w") as f:
+                f.write(str(start + n))
+            return list(range(start, start + n))
+        finally:
+            os.unlink(lock)
+
+    # -- docs -----------------------------------------------------------
+    def insert(self, doc):
+        _write_doc(self.trial_path(doc["tid"]), doc)
+
+    def write(self, doc):
+        _write_doc(self.trial_path(doc["tid"]), doc)
+
+    def all_docs(self):
+        docs = []
+        for p in sorted(glob.glob(os.path.join(self.root, "trials", "*.json"))):
+            doc = _read_doc(p)
+            if doc is not None:
+                docs.append(doc)
+        return docs
+
+    # -- reservation -----------------------------------------------------
+    def reserve(self, owner):
+        """Atomically claim one JOB_STATE_NEW trial; None if none available.
+
+        Exclusive lock-file creation is the only synchronization primitive,
+        exactly as Mongo's atomic owner-stamping is the reference's.
+        """
+        for p in sorted(glob.glob(os.path.join(self.root, "trials", "*.json"))):
+            doc = _read_doc(p)
+            if doc is None or doc["state"] != JOB_STATE_NEW:
+                continue
+            lock = self.lock_path(doc["tid"])
+            try:
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                continue  # someone else owns it
+            with os.fdopen(fd, "w") as f:
+                f.write(owner)
+            doc = _read_doc(p)  # re-read under the lock
+            if doc is None or doc["state"] != JOB_STATE_NEW:
+                continue
+            doc["state"] = JOB_STATE_RUNNING
+            doc["owner"] = owner
+            doc["book_time"] = coarse_utcnow()
+            doc["refresh_time"] = coarse_utcnow()
+            self.write(doc)
+            return doc
+        return None
+
+    def requeue_stale(self, max_age_secs):
+        """Re-queue RUNNING trials whose reservation is older than
+        ``max_age_secs`` (recovery beyond the reference's capability —
+        Mongo leaves dead workers' jobs reserved forever)."""
+        n = 0
+        now = coarse_utcnow()
+        for doc in self.all_docs():
+            if doc["state"] != JOB_STATE_RUNNING:
+                continue
+            booked = doc.get("book_time")
+            if booked is None or (now - booked).total_seconds() > max_age_secs:
+                try:
+                    os.unlink(self.lock_path(doc["tid"]))
+                except FileNotFoundError:
+                    pass
+                doc["state"] = JOB_STATE_NEW
+                doc["owner"] = None
+                doc["book_time"] = None
+                self.write(doc)
+                n += 1
+        return n
+
+    # -- attachments -----------------------------------------------------
+    def set_attachment(self, key, value: bytes):
+        _atomic_write(self.attachment_path(key), value)
+
+    def get_attachment(self, key) -> bytes:
+        with open(self.attachment_path(key), "rb") as f:
+            return f.read()
+
+    def has_attachment(self, key):
+        return os.path.exists(self.attachment_path(key))
+
+    def del_attachment(self, key):
+        os.unlink(self.attachment_path(key))
+
+    def attachment_keys(self):
+        d = os.path.join(self.root, "attachments")
+        return sorted(os.listdir(d))
+
+
+class _FileAttachments(MutableMapping):
+    def __init__(self, jobs: FileJobs):
+        self._jobs = jobs
+
+    def __getitem__(self, key):
+        try:
+            return self._jobs.get_attachment(key)
+        except FileNotFoundError:
+            raise KeyError(key)
+
+    def __setitem__(self, key, value):
+        if not isinstance(value, bytes):
+            value = pickle.dumps(value)
+        self._jobs.set_attachment(key, value)
+
+    def __delitem__(self, key):
+        try:
+            self._jobs.del_attachment(key)
+        except FileNotFoundError:
+            raise KeyError(key)
+
+    def __iter__(self):
+        return iter(self._jobs.attachment_keys())
+
+    def __len__(self):
+        return len(self._jobs.attachment_keys())
+
+
+class FileTrials(Trials):
+    """Durable multi-process Trials store over a shared directory."""
+
+    asynchronous = True
+    poll_interval_secs = 0.25
+
+    def __init__(self, queue_dir, exp_key=None, refresh=True):
+        self.jobs = FileJobs(queue_dir)
+        super().__init__(exp_key=exp_key, refresh=False)
+        self.attachments = _FileAttachments(self.jobs)
+        if refresh:
+            self.refresh()
+
+    def refresh(self):
+        self._dynamic_trials = self.jobs.all_docs()
+        super().refresh()
+
+    def _insert_trial_docs(self, docs):
+        rval = []
+        for doc in docs:
+            self.jobs.insert(doc)
+            rval.append(doc["tid"])
+        self._dynamic_trials.extend(docs)
+        return rval
+
+    def new_trial_ids(self, n):
+        ids = self.jobs.new_trial_ids(n)
+        self._ids.update(ids)
+        return ids
+
+    def delete_all(self):
+        for p in glob.glob(os.path.join(self.jobs.root, "trials", "*.json")):
+            os.unlink(p)
+        for p in glob.glob(os.path.join(self.jobs.root, "locks", "*.lock")):
+            os.unlink(p)
+        for k in list(self.attachments):
+            del self.attachments[k]
+        counter = os.path.join(self.jobs.root, "ids.counter")
+        if os.path.exists(counter):
+            os.unlink(counter)
+        self._dynamic_trials = []
+        from ..base import _TrialsHistory
+
+        self._history = _TrialsHistory()
+        self.refresh()
+
+    def count_by_state_unsynced(self, arg):
+        self.refresh()
+        return super().count_by_state_unsynced(arg)
+
+
+class FileCtrl(Ctrl):
+    """Ctrl whose checkpoint persists partial results to the queue
+    (the MongoCtrl analog)."""
+
+    def __init__(self, trials: FileTrials, current_trial):
+        super().__init__(trials, current_trial)
+
+    def checkpoint(self, result=None):
+        if result is not None:
+            self.current_trial["result"] = result
+        self.current_trial["refresh_time"] = coarse_utcnow()
+        self.trials.jobs.write(self.current_trial)
+
+
+def default_owner():
+    return f"{socket.gethostname()}:{os.getpid()}"

@@ -1,0 +1,177 @@
+"""Worker daemon for the FileTrials queue.
+
+Reference parity (SURVEY.md §2 #17): ``hyperopt/mongoexp.py`` —
+``MongoWorker.run_one`` (reserve → temp workdir → unpickle domain from the
+'FMinIter_Domain' attachment → ``domain.evaluate`` → write result,
+error → ``JOB_STATE_ERROR``) (~L800-1050) and the
+``hyperopt-mongo-worker`` CLI (``main_worker_helper``: ``--poll-interval``,
+``--max-consecutive-failures``, ``--reserve-timeout``, ``--workdir``,
+``--last-job-timeout``) (~L1050-1300).
+
+Run one worker per host/slice::
+
+    python -m hyperopt_tpu.parallel.worker --queue /shared/q --workdir /tmp/w
+
+Workers are stateless: kill and restart at any time; elasticity falls out
+of the shared queue (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import pickle
+import sys
+import time
+from timeit import default_timer as timer
+
+from ..base import JOB_STATE_DONE, JOB_STATE_ERROR, spec_from_misc
+from ..utils import coarse_utcnow, temp_dir, working_dir
+from .file_trials import FileCtrl, FileTrials, default_owner
+
+logger = logging.getLogger(__name__)
+
+
+class ReserveTimeout(Exception):
+    """No job became available within --reserve-timeout."""
+
+
+class FileWorker:
+    poll_interval = 1.0
+
+    def __init__(
+        self,
+        queue_dir,
+        poll_interval=1.0,
+        workdir=None,
+        exp_key=None,
+        logfilename=None,
+    ):
+        self.trials = FileTrials(queue_dir, exp_key=exp_key)
+        self.poll_interval = poll_interval
+        self.workdir = workdir
+        self.owner = default_owner()
+        self._domain = None
+        self._domain_blob = None
+
+    def _load_domain(self):
+        blob = self.trials.attachments["FMinIter_Domain"]
+        if blob != self._domain_blob:
+            self._domain = pickle.loads(blob)
+            self._domain_blob = blob
+        return self._domain
+
+    def run_one(self, host_id=None, reserve_timeout=None, erase_created_workdir=False):
+        """Reserve and execute one trial; raises ReserveTimeout if none."""
+        start = timer()
+        job = None
+        while job is None:
+            job = self.trials.jobs.reserve(host_id or self.owner)
+            if job is None:
+                if reserve_timeout is not None and timer() - start > reserve_timeout:
+                    raise ReserveTimeout(
+                        f"no job within {reserve_timeout}s at {self.trials.jobs.root}"
+                    )
+                time.sleep(self.poll_interval)
+
+        logger.info("worker %s reserved trial %s", self.owner, job["tid"])
+        spec = spec_from_misc(job["misc"])
+        ctrl = FileCtrl(self.trials, job)
+        try:
+            domain = self._load_domain()
+            workdir = self.workdir or os.path.join(
+                self.trials.jobs.root, "workdir", str(job["tid"])
+            )
+            with temp_dir(workdir, erase_after=erase_created_workdir), working_dir(
+                workdir
+            ):
+                result = domain.evaluate(spec, ctrl)
+        except Exception as e:
+            logger.error("trial %s failed: %s", job["tid"], e)
+            job["state"] = JOB_STATE_ERROR
+            job["misc"]["error"] = (str(type(e)), str(e))
+            job["refresh_time"] = coarse_utcnow()
+            self.trials.jobs.write(job)
+            raise
+        job["result"] = result
+        job["state"] = JOB_STATE_DONE
+        job["refresh_time"] = coarse_utcnow()
+        self.trials.jobs.write(job)
+        return job
+
+
+def main_worker_helper(options):
+    if options.max_consecutive_failures <= 0:
+        raise ValueError("--max-consecutive-failures must be positive")
+    worker = FileWorker(
+        options.queue,
+        poll_interval=options.poll_interval,
+        workdir=options.workdir,
+        exp_key=options.exp_key,
+    )
+    consecutive_failures = 0
+    n_done = 0
+    start = timer()
+    while True:
+        if options.last_job_timeout is not None and (
+            timer() - start > options.last_job_timeout
+        ):
+            logger.info("--last-job-timeout reached, exiting")
+            break
+        try:
+            worker.run_one(reserve_timeout=options.reserve_timeout)
+            consecutive_failures = 0
+            n_done += 1
+        except ReserveTimeout:
+            logger.info("reserve timeout, exiting after %d jobs", n_done)
+            break
+        except Exception as e:
+            consecutive_failures += 1
+            logger.error(
+                "job failure %d/%d: %s",
+                consecutive_failures,
+                options.max_consecutive_failures,
+                e,
+            )
+            if consecutive_failures >= options.max_consecutive_failures:
+                logger.error("too many consecutive failures, exiting")
+                return 1
+        if options.max_jobs is not None and n_done >= options.max_jobs:
+            break
+    return 0
+
+
+def make_parser():
+    p = argparse.ArgumentParser(
+        prog="hyperopt-tpu-worker",
+        description="Execute trials from a FileTrials queue directory.",
+    )
+    p.add_argument("--queue", required=True, help="shared queue directory")
+    p.add_argument("--exp-key", default=None, dest="exp_key")
+    p.add_argument("--poll-interval", type=float, default=1.0, dest="poll_interval")
+    p.add_argument(
+        "--max-consecutive-failures",
+        type=int,
+        default=4,
+        dest="max_consecutive_failures",
+    )
+    p.add_argument(
+        "--reserve-timeout", type=float, default=120.0, dest="reserve_timeout"
+    )
+    p.add_argument("--workdir", default=None)
+    p.add_argument(
+        "--last-job-timeout", type=float, default=None, dest="last_job_timeout"
+    )
+    p.add_argument("--max-jobs", type=int, default=None, dest="max_jobs")
+    return p
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    options = make_parser().parse_args(argv)
+    return main_worker_helper(options)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

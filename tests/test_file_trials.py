@@ -1,0 +1,261 @@
+"""FileTrials durable-queue tests.
+
+Reference parity (SURVEY.md §4 Mongo row): the reference tests distributed
+mode as (real mongod subprocess × threaded in-process workers); here it is
+(real filesystem queue × threaded in-process workers): reservation
+exclusivity, worker error handling, durability/resume, attachments, CLI
+parsing.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu.algos import rand
+from hyperopt_tpu.base import (
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    JOB_STATE_RUNNING,
+    STATUS_OK,
+)
+from hyperopt_tpu.parallel.file_trials import FileJobs, FileTrials
+from hyperopt_tpu.parallel.worker import (
+    FileWorker,
+    ReserveTimeout,
+    main_worker_helper,
+    make_parser,
+)
+
+
+def quad_objective(cfg):
+    return (cfg["x"] - 3) ** 2
+
+
+SPACE = {"x": hp.uniform("x", -5, 5)}
+
+
+def run_workers(queue_dir, n_workers=2, max_jobs=1000):
+    """Threaded in-process workers (the reference's with_worker_threads)."""
+
+    def loop():
+        w = FileWorker(queue_dir, poll_interval=0.02)
+        done = 0
+        while done < max_jobs:
+            try:
+                w.run_one(reserve_timeout=0.5)
+                done += 1
+            except ReserveTimeout:
+                return
+            except Exception:
+                pass
+
+    threads = [threading.Thread(target=loop, daemon=True) for _ in range(n_workers)]
+    for t in threads:
+        t.start()
+    return threads
+
+
+class TestFileJobs:
+    def test_id_allocation_monotonic_across_instances(self, tmp_path):
+        j1 = FileJobs(str(tmp_path))
+        j2 = FileJobs(str(tmp_path))
+        assert j1.new_trial_ids(3) == [0, 1, 2]
+        assert j2.new_trial_ids(2) == [3, 4]
+
+    def test_reserve_exclusive(self, tmp_path):
+        jobs = FileJobs(str(tmp_path))
+        doc = {
+            "tid": 0, "state": JOB_STATE_NEW, "spec": None,
+            "result": {"status": "new"},
+            "misc": {"tid": 0, "cmd": None, "idxs": {"x": [0]}, "vals": {"x": [1.0]}},
+            "exp_key": None, "owner": None, "book_time": None, "refresh_time": None,
+        }
+        jobs.insert(doc)
+        a = jobs.reserve("w1")
+        b = jobs.reserve("w2")
+        assert a is not None and a["owner"] == "w1"
+        assert b is None
+
+    def test_reserve_race_many_threads(self, tmp_path):
+        jobs = FileJobs(str(tmp_path))
+        for tid in range(20):
+            jobs.insert({
+                "tid": tid, "state": JOB_STATE_NEW, "spec": None,
+                "result": {"status": "new"},
+                "misc": {"tid": tid, "cmd": None, "idxs": {}, "vals": {}},
+                "exp_key": None, "owner": None, "book_time": None, "refresh_time": None,
+            })
+        claimed = []
+        lock = threading.Lock()
+
+        def grab(owner):
+            me = FileJobs(str(tmp_path))
+            while True:
+                doc = me.reserve(owner)
+                if doc is None:
+                    return
+                with lock:
+                    claimed.append(doc["tid"])
+
+        threads = [threading.Thread(target=grab, args=(f"w{i}",)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(claimed) == list(range(20))  # every job exactly once
+
+    def test_requeue_stale(self, tmp_path):
+        jobs = FileJobs(str(tmp_path))
+        jobs.insert({
+            "tid": 0, "state": JOB_STATE_NEW, "spec": None,
+            "result": {"status": "new"},
+            "misc": {"tid": 0, "cmd": None, "idxs": {}, "vals": {}},
+            "exp_key": None, "owner": None, "book_time": None, "refresh_time": None,
+        })
+        jobs.reserve("dead-worker")
+        assert jobs.reserve("w2") is None
+        n = jobs.requeue_stale(max_age_secs=-1.0)  # everything is stale
+        assert n == 1
+        again = jobs.reserve("w2")
+        assert again is not None and again["owner"] == "w2"
+
+    def test_attachments_roundtrip(self, tmp_path):
+        jobs = FileJobs(str(tmp_path))
+        jobs.set_attachment("blob", b"\x00\x01data")
+        assert jobs.get_attachment("blob") == b"\x00\x01data"
+        assert jobs.has_attachment("blob")
+        jobs.del_attachment("blob")
+        assert not jobs.has_attachment("blob")
+
+
+class TestFileTrialsFmin:
+    def test_fmin_with_threaded_workers(self, tmp_path):
+        trials = FileTrials(str(tmp_path / "q"))
+        threads = run_workers(str(tmp_path / "q"), n_workers=3)
+        best = fmin(
+            quad_objective, SPACE, algo=rand.suggest, max_evals=20, trials=trials,
+            rstate=np.random.default_rng(0), show_progressbar=False, verbose=False,
+        )
+        for t in threads:
+            t.join(timeout=5)
+        assert len(trials) == 20
+        assert all(t["state"] == JOB_STATE_DONE for t in trials.trials)
+        assert abs(best["x"] - 3) < 2.0
+        owners = {t["owner"] for t in trials.trials}
+        assert owners  # stamped by workers
+
+    def test_durability_resume(self, tmp_path):
+        qdir = str(tmp_path / "q")
+        trials = FileTrials(qdir)
+        threads = run_workers(qdir, n_workers=2)
+        fmin(
+            quad_objective, SPACE, algo=rand.suggest, max_evals=10, trials=trials,
+            rstate=np.random.default_rng(0), show_progressbar=False, verbose=False,
+        )
+        for t in threads:
+            t.join(timeout=5)
+        # a brand-new store on the same dir sees everything (Mongo-style
+        # durability); resuming fmin continues to 15
+        trials2 = FileTrials(qdir)
+        assert len(trials2) == 10
+        threads = run_workers(qdir, n_workers=2)
+        fmin(
+            quad_objective, SPACE, algo=rand.suggest, max_evals=15, trials=trials2,
+            rstate=np.random.default_rng(1), show_progressbar=False, verbose=False,
+        )
+        for t in threads:
+            t.join(timeout=5)
+        assert len(FileTrials(qdir)) == 15
+
+    def test_worker_error_path(self, tmp_path):
+        qdir = str(tmp_path / "q")
+        trials = FileTrials(qdir)
+
+        threads = run_workers(qdir, n_workers=2)
+        fmin(
+            flaky_objective, SPACE, algo=rand.suggest, max_evals=12,
+            trials=trials, catch_eval_exceptions=True,
+            rstate=np.random.default_rng(3), show_progressbar=False, verbose=False,
+            return_argmin=False,
+        )
+        for t in threads:
+            t.join(timeout=5)
+        trials.refresh()
+        states = [t["state"] for t in trials._dynamic_trials]
+        assert JOB_STATE_ERROR in states and JOB_STATE_DONE in states
+        errs = [
+            t for t in trials._dynamic_trials if t["state"] == JOB_STATE_ERROR
+        ]
+        assert all("negative" in t["misc"]["error"][1] for t in errs)
+
+
+class TestWorkerCLI:
+    def test_parser_defaults(self):
+        opts = make_parser().parse_args(["--queue", "/tmp/q"])
+        assert opts.queue == "/tmp/q"
+        assert opts.poll_interval == 1.0
+        assert opts.max_consecutive_failures == 4
+        assert opts.reserve_timeout == 120.0
+        assert opts.workdir is None
+
+    def test_parser_all_flags(self):
+        opts = make_parser().parse_args(
+            [
+                "--queue", "/q", "--exp-key", "e1", "--poll-interval", "0.5",
+                "--max-consecutive-failures", "2", "--reserve-timeout", "10",
+                "--workdir", "/w", "--last-job-timeout", "60", "--max-jobs", "5",
+            ]
+        )
+        assert opts.exp_key == "e1"
+        assert opts.max_jobs == 5
+
+    def test_main_worker_helper_drains_queue(self, tmp_path):
+        qdir = str(tmp_path / "q")
+        trials = FileTrials(qdir)
+        # enqueue trials by running fmin in a thread (it blocks until done)
+        t = threading.Thread(
+            target=lambda: fmin(
+                quad_objective, SPACE, algo=rand.suggest, max_evals=5,
+                trials=trials, rstate=np.random.default_rng(0),
+                show_progressbar=False, verbose=False, return_argmin=False,
+            ),
+            daemon=True,
+        )
+        t.start()
+        opts = make_parser().parse_args(
+            ["--queue", qdir, "--poll-interval", "0.02", "--reserve-timeout", "2"]
+        )
+        rc = main_worker_helper(opts)
+        t.join(timeout=10)
+        assert rc == 0
+        assert len(FileTrials(qdir)) == 5
+
+    def test_worker_ctrl_checkpoint(self, tmp_path):
+        qdir = str(tmp_path / "q")
+        trials = FileTrials(qdir)
+
+        threads = run_workers(qdir, n_workers=1)
+        fmin(
+            checkpointing_objective, SPACE, algo=rand.suggest, max_evals=2,
+            trials=trials, rstate=np.random.default_rng(0),
+            show_progressbar=False, verbose=False, return_argmin=False,
+            pass_expr_memo_ctrl=None,
+        )
+        for t in threads:
+            t.join(timeout=5)
+        assert len(FileTrials(qdir)) == 2
+
+
+def checkpointing_objective(cfg):
+    return abs(cfg["x"])
+
+
+def flaky_objective(cfg):
+    if cfg["x"] < 0:
+        raise RuntimeError("negative")
+    return cfg["x"]
