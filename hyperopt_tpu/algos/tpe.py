@@ -362,6 +362,7 @@ def suggest(
     below_tids = ap_split_trials(
         hist.loss_tids, hist.losses, gamma, gamma_cap=linear_forgetting
     )
+    below_arr = np.fromiter(below_tids, dtype=np.int64, count=len(below_tids))
 
     specs = domain.space.specs
     key = jax.random.PRNGKey(int(seed))
@@ -371,9 +372,7 @@ def suggest(
     for ki, (label, spec) in enumerate(specs.items()):
         tids = hist.idxs.get(label, np.zeros(0, dtype=np.int64))
         obs = np.asarray(hist.vals.get(label, np.zeros(0)), dtype=np.float64)
-        below_mask = np.fromiter(
-            (int(t) in below_tids for t in tids), dtype=bool, count=len(tids)
-        )
+        below_mask = np.isin(np.asarray(tids, dtype=np.int64), below_arr)
         b_obs = obs[below_mask]
         a_obs = obs[~below_mask]
 
