@@ -46,7 +46,7 @@ from .fmin import (
     generate_trials_to_calculate,
     space_eval,
 )
-from .algos import anneal, criteria, mix, rand, tpe
+from .algos import anneal, atpe, criteria, mix, rand, tpe
 from .early_stop import no_progress_loss
 from .parallel import FileTrials, JaxTrials
 
@@ -78,6 +78,7 @@ __all__ = [
     "JaxTrials",
     "Trials",
     "anneal",
+    "atpe",
     "criteria",
     "fmin",
     "fmin_pass_expr_memo_ctrl",

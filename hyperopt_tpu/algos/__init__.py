@@ -5,6 +5,6 @@ returning new trial documents — the reference's plugin boundary
 (``hyperopt/base.py — Trials.fmin``, SURVEY.md §1), preserved exactly.
 """
 
-from . import anneal, criteria, mix, rand, tpe
+from . import anneal, atpe, criteria, mix, rand, tpe
 
-__all__ = ["anneal", "criteria", "mix", "rand", "tpe"]
+__all__ = ["anneal", "atpe", "criteria", "mix", "rand", "tpe"]

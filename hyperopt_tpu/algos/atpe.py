@@ -1,0 +1,330 @@
+"""Adaptive TPE: meta-learned TPE configuration + parameter locking.
+
+Reference parity (SURVEY.md §2 #15): ``hyperopt/atpe.py`` +
+``hyperopt/atpe_models/`` — ``Hyperparameter`` space featurization from
+``expr_to_config`` (~L50-300), parameter-lock/cascade logic (~L300-700),
+``ATPEOptimizer`` (~20 space/history features → pretrained LightGBM
+regressors/classifiers → TPE meta-params ``gamma``, ``n_EI_candidates``,
+``resultFilteringMode``, ``secondaryCutoff`` → delegation to TPE with
+per-parameter filtering) (~L700-1800), ``suggest`` (~L1800-1850).
+
+Artifact policy: the reference ships pretrained LightGBM model files
+(``scaling_model.json``, ``model-<target>.txt``).  LightGBM is absent from
+this image and the training corpus is not retrievable offline, so this
+implementation preserves the *architecture* — featurizer → meta-model →
+TPE delegation with per-parameter locking — with two meta-model sources:
+
+1. ``ATPEOptimizer(model_dir=...)`` loads sklearn estimators (pickled,
+   one per target, plus ``scaling_model.json`` feature-normalization
+   stats — the same artifact shape as the reference); and
+2. a deterministic heuristic fallback (documented per-rule below) used
+   when no artifacts are present, tuned to reproduce ATPE's qualitative
+   behavior: exploit harder as evidence accumulates, spend more
+   candidates in higher dimensions, and lock low-influence parameters to
+   their incumbent values (the "cascade").
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pickle
+from functools import partial
+
+import numpy as np
+
+from ..pyll_utils import expr_to_config
+from . import rand, tpe
+
+logger = logging.getLogger(__name__)
+
+_default_n_startup_jobs = 20
+
+
+class Hyperparameter:
+    """Featurized view of one search-space parameter."""
+
+    CONTINUOUS_DISTS = {
+        "uniform", "quniform", "loguniform", "qloguniform",
+        "normal", "qnormal", "lognormal", "qlognormal", "uniformint",
+    }
+
+    def __init__(self, label, spec):
+        self.label = label
+        self.spec = spec
+
+    @property
+    def is_categorical(self):
+        return self.spec.dist in ("randint", "categorical")
+
+    @property
+    def is_log_scale(self):
+        return self.spec.dist in ("loguniform", "qloguniform", "lognormal", "qlognormal")
+
+    @property
+    def is_conditional(self):
+        conds = self.spec.conditions
+        return bool(conds) and not any(len(c) == 0 for c in conds)
+
+    @property
+    def cardinality(self):
+        """log2 of the (approximate) number of distinct values."""
+        p = self.spec.params
+        if self.is_categorical:
+            return float(np.log2(max(self.spec.upper or 2, 2)))
+        q = p.get("q")
+        if q:
+            if self.spec.dist in ("quniform", "uniformint"):
+                return float(np.log2(max((p["high"] - p["low"]) / q, 2)))
+            return 6.0  # quantized unbounded: moderate
+        return 20.0  # continuous
+
+    def feature_vector(self):
+        return np.array(
+            [
+                1.0 if self.is_categorical else 0.0,
+                1.0 if self.is_log_scale else 0.0,
+                1.0 if self.is_conditional else 0.0,
+                self.cardinality,
+            ]
+        )
+
+
+# targets the meta-model predicts (reference: gamma, nEICandidates,
+# resultFilteringMode, secondaryCutoff, ...)
+META_TARGETS = ("gamma", "n_EI_candidates", "prior_weight", "secondary_cutoff")
+
+FEATURE_NAMES = (
+    "n_parameters",
+    "frac_categorical",
+    "frac_conditional",
+    "frac_log_scale",
+    "mean_log2_cardinality",
+    "n_trials",
+    "log_n_trials",
+    "best_loss",
+    "loss_std",
+    "loss_iqr",
+    "loss_skew",
+    "recent_improvement",
+    "frac_failed",
+    "top_frac_spread",
+    "mean_abs_param_loss_corr",
+    "max_abs_param_loss_corr",
+    "min_abs_param_loss_corr",
+)
+
+
+class ATPEOptimizer:
+    def __init__(self, model_dir=None):
+        self.models = {}
+        self.scaling = None
+        if model_dir:
+            self.load_models(model_dir)
+
+    # -- artifact loading (reference artifact shape) --------------------
+    def load_models(self, model_dir):
+        scaling_path = os.path.join(model_dir, "scaling_model.json")
+        if os.path.exists(scaling_path):
+            with open(scaling_path) as f:
+                self.scaling = json.load(f)
+        for target in META_TARGETS:
+            p = os.path.join(model_dir, f"model-{target}.pkl")
+            if os.path.exists(p):
+                with open(p, "rb") as f:
+                    self.models[target] = pickle.load(f)
+        logger.info(
+            "atpe: loaded %d meta-models from %s", len(self.models), model_dir
+        )
+
+    # -- featurization ---------------------------------------------------
+    @staticmethod
+    def hyperparameters(domain):
+        return {
+            lb: Hyperparameter(lb, sp) for lb, sp in domain.space.specs.items()
+        }
+
+    def compute_features(self, domain, trials):
+        hps = self.hyperparameters(domain)
+        hist = trials.history
+        losses = np.asarray(hist.losses, dtype=float)
+        n = len(losses)
+
+        hp_feats = np.array([h.feature_vector() for h in hps.values()])
+        n_params = len(hps)
+
+        # per-parameter |spearman-ish| correlation of value vs loss
+        loss_by_tid = dict(zip(hist.loss_tids.tolist(), losses.tolist()))
+        corrs = []
+        for lb in hps:
+            tids = hist.idxs.get(lb, [])
+            vals = hist.vals.get(lb, [])
+            pts = [
+                (float(v), loss_by_tid[int(t)])
+                for t, v in zip(tids, vals)
+                if int(t) in loss_by_tid
+            ]
+            if len(pts) < 5:
+                corrs.append(0.0)
+                continue
+            v, l = np.array(pts).T
+            vr = np.argsort(np.argsort(v)).astype(float)
+            lr = np.argsort(np.argsort(l)).astype(float)
+            denom = v.std() and (vr.std() * lr.std())
+            c = 0.0 if not denom else float(np.corrcoef(vr, lr)[0, 1])
+            corrs.append(abs(c) if np.isfinite(c) else 0.0)
+        corrs = np.asarray(corrs) if corrs else np.zeros(1)
+
+        if n:
+            srt = np.sort(losses)
+            k = max(1, int(np.ceil(0.25 * np.sqrt(n))))
+            top_spread = float(srt[: max(2, k)].std())
+            q25, q75 = np.percentile(losses, [25, 75])
+            med = np.median(losses)
+            mean = losses.mean()
+            std = losses.std() or 1.0
+            skew = float((mean - med) / std)
+            half = n // 2 or 1
+            recent = float(
+                np.min(losses[:half]) - np.min(losses[half:]) if n >= 4 else 0.0
+            )
+        else:
+            top_spread, q25, q75, skew, recent = 0.0, 0.0, 0.0, 0.0, 0.0
+
+        n_total = len(trials.trials) or 1
+        feats = {
+            "n_parameters": float(n_params),
+            "frac_categorical": float(hp_feats[:, 0].mean()) if n_params else 0.0,
+            "frac_conditional": float(hp_feats[:, 2].mean()) if n_params else 0.0,
+            "frac_log_scale": float(hp_feats[:, 1].mean()) if n_params else 0.0,
+            "mean_log2_cardinality": float(hp_feats[:, 3].mean()) if n_params else 0.0,
+            "n_trials": float(n),
+            "log_n_trials": float(np.log1p(n)),
+            "best_loss": float(losses.min()) if n else 0.0,
+            "loss_std": float(losses.std()) if n else 0.0,
+            "loss_iqr": float(q75 - q25),
+            "loss_skew": skew,
+            "recent_improvement": recent,
+            "frac_failed": float(1.0 - n / n_total),
+            "top_frac_spread": top_spread,
+            "mean_abs_param_loss_corr": float(corrs.mean()),
+            "max_abs_param_loss_corr": float(corrs.max()),
+            "min_abs_param_loss_corr": float(corrs.min()),
+        }
+        per_param_corr = dict(zip(hps.keys(), corrs)) if n_params else {}
+        return feats, per_param_corr
+
+    # -- meta prediction -------------------------------------------------
+    def _vectorize(self, feats):
+        x = np.array([[feats[k] for k in FEATURE_NAMES]])
+        if self.scaling:
+            mu = np.array([self.scaling["mean"][k] for k in FEATURE_NAMES])
+            sd = np.array([self.scaling["std"][k] for k in FEATURE_NAMES])
+            x = (x - mu) / np.where(sd > 0, sd, 1.0)
+        return x
+
+    def predict_meta(self, feats):
+        """Meta-parameters for this suggest step (models else heuristics)."""
+        meta = self._heuristic_meta(feats)
+        if self.models:
+            x = self._vectorize(feats)
+            for target, model in self.models.items():
+                try:
+                    meta[target] = float(model.predict(x)[0])
+                except Exception as e:  # corrupt artifact: keep heuristic
+                    logger.warning("atpe model %s failed: %s", target, e)
+        meta["gamma"] = float(np.clip(meta["gamma"], 0.1, 0.5))
+        meta["n_EI_candidates"] = int(np.clip(meta["n_EI_candidates"], 8, 4096))
+        meta["prior_weight"] = float(np.clip(meta["prior_weight"], 0.25, 2.0))
+        meta["secondary_cutoff"] = float(np.clip(meta["secondary_cutoff"], 0.0, 1.0))
+        return meta
+
+    @staticmethod
+    def _heuristic_meta(feats):
+        """Deterministic fallback rules (documented):
+        - γ shrinks as evidence accumulates (exploit harder late);
+        - candidate count grows ~ sqrt(dimensionality) — cheap on TPU;
+        - prior weight decays once the history dwarfs the prior;
+        - secondary cutoff (lock threshold) rises with dimensionality so
+          high-dim spaces get more aggressive cascading."""
+        n = feats["n_trials"]
+        gamma = 0.30 - 0.05 * np.tanh((n - 50.0) / 100.0) - 0.1 * np.tanh(
+            feats["mean_abs_param_loss_corr"]
+        )
+        n_ei = 24 * max(1.0, np.sqrt(feats["n_parameters"]))
+        if n > 200:
+            n_ei *= 2
+        prior_weight = 1.0 if n < 100 else 0.5
+        secondary_cutoff = float(
+            np.clip(0.05 + 0.01 * feats["n_parameters"], 0.05, 0.3)
+        )
+        return {
+            "gamma": float(gamma),
+            "n_EI_candidates": float(n_ei),
+            "prior_weight": prior_weight,
+            "secondary_cutoff": secondary_cutoff,
+        }
+
+    # -- parameter locking (the cascade) ---------------------------------
+    @staticmethod
+    def choose_locks(per_param_corr, cutoff, rng):
+        """Lock params whose loss-rank correlation is below ``cutoff`` with
+        probability 1/2 each (keeps exploration alive, like the
+        reference's filtered-parameter resampling)."""
+        locked = []
+        for lb, corr in per_param_corr.items():
+            if corr < cutoff and rng.uniform() < 0.5:
+                locked.append(lb)
+        return locked
+
+
+def suggest(
+    new_ids,
+    domain,
+    trials,
+    seed,
+    n_startup_jobs=_default_n_startup_jobs,
+    model_dir=None,
+    verbose=True,
+):
+    """ATPE suggest: featurize → meta-params → TPE with parameter locks."""
+    hist = trials.history
+    if len(hist.losses) < n_startup_jobs:
+        return rand.suggest(new_ids, domain, trials, seed)
+
+    optimizer = ATPEOptimizer(model_dir=model_dir)
+    feats, per_param_corr = optimizer.compute_features(domain, trials)
+    meta = optimizer.predict_meta(feats)
+    rng = np.random.default_rng(seed)
+    locked = optimizer.choose_locks(
+        per_param_corr, meta["secondary_cutoff"], rng
+    )
+
+    docs = tpe.suggest(
+        new_ids,
+        domain,
+        trials,
+        seed,
+        prior_weight=meta["prior_weight"],
+        n_startup_jobs=n_startup_jobs,
+        n_EI_candidates=meta["n_EI_candidates"],
+        gamma=meta["gamma"],
+    )
+
+    if locked:
+        # overwrite locked params with the incumbent best trial's values
+        try:
+            best_misc = trials.best_trial["misc"]
+        except Exception:
+            return docs
+        for doc in docs:
+            for lb in locked:
+                if (
+                    doc["misc"]["vals"].get(lb)
+                    and best_misc["vals"].get(lb)
+                ):
+                    doc["misc"]["vals"][lb] = list(best_misc["vals"][lb])
+        if verbose:
+            logger.debug("atpe locked params: %s (meta=%s)", locked, meta)
+    return docs

@@ -1,0 +1,141 @@
+"""ATPE tests (reference parity: test_atpe_basic.py smoke + featurizer and
+cascade behavior checks).
+"""
+
+import json
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Domain, Trials, fmin
+from hyperopt_tpu.algos import atpe, rand
+from hyperopt_tpu.algos.atpe import (
+    ATPEOptimizer,
+    FEATURE_NAMES,
+    META_TARGETS,
+    Hyperparameter,
+)
+from hyperopt_tpu.models import domains
+
+
+def seeded_trials(d, n=40, seed=0):
+    trials = Trials()
+    fmin(
+        d.fn, d.space, algo=rand.suggest, max_evals=n, trials=trials,
+        rstate=np.random.default_rng(seed), show_progressbar=False, verbose=False,
+    )
+    return trials
+
+
+class TestFeaturizer:
+    def test_hyperparameter_features(self):
+        d = domains.get("many_dists")
+        domain = Domain(d.fn, d.space)
+        hps = ATPEOptimizer.hyperparameters(domain)
+        assert set(hps) == set(domain.space.specs)
+        a = hps["a"]  # hp.choice
+        assert a.is_categorical and not a.is_log_scale
+        assert hps["d"].is_log_scale  # loguniform
+        assert all(len(h.feature_vector()) == 4 for h in hps.values())
+
+    def test_compute_features_complete(self):
+        d = domains.get("branin")
+        domain = Domain(d.fn, d.space)
+        trials = seeded_trials(d)
+        feats, corr = ATPEOptimizer().compute_features(domain, trials)
+        assert set(feats) == set(FEATURE_NAMES)
+        assert all(np.isfinite(v) for v in feats.values())
+        assert set(corr) == {"x", "y"}
+        assert feats["n_trials"] == 40
+        assert feats["n_parameters"] == 2
+
+    def test_informative_param_has_higher_corr(self):
+        # loss depends on z only (many_dists fn ~ z^2)
+        d = domains.get("many_dists")
+        domain = Domain(d.fn, d.space)
+        trials = seeded_trials(d, n=80)
+        _, corr = ATPEOptimizer().compute_features(domain, trials)
+        assert corr["z"] < 0.999  # sanity
+        assert corr["z"] >= max(corr["b"], corr["g"]) - 0.15
+
+
+class TestMetaPrediction:
+    def test_heuristic_meta_in_bounds(self):
+        d = domains.get("hartmann6")
+        domain = Domain(d.fn, d.space)
+        trials = seeded_trials(d, n=60)
+        feats, _ = ATPEOptimizer().compute_features(domain, trials)
+        meta = ATPEOptimizer().predict_meta(feats)
+        assert 0.1 <= meta["gamma"] <= 0.5
+        assert 8 <= meta["n_EI_candidates"] <= 4096
+        assert 0.25 <= meta["prior_weight"] <= 2.0
+        assert set(meta) >= set(META_TARGETS)
+
+    def test_sklearn_artifact_loading(self, tmp_path):
+        from sklearn.linear_model import LinearRegression
+
+        d = domains.get("branin")
+        domain = Domain(d.fn, d.space)
+        trials = seeded_trials(d)
+        opt0 = ATPEOptimizer()
+        feats, _ = opt0.compute_features(domain, trials)
+
+        # artifact shapes mirror the reference's atpe_models/
+        scaling = {
+            "mean": {k: 0.0 for k in FEATURE_NAMES},
+            "std": {k: 1.0 for k in FEATURE_NAMES},
+        }
+        with open(tmp_path / "scaling_model.json", "w") as f:
+            json.dump(scaling, f)
+        X = np.random.default_rng(0).normal(size=(20, len(FEATURE_NAMES)))
+        model = LinearRegression().fit(X, np.full(20, 0.33))
+        with open(tmp_path / "model-gamma.pkl", "wb") as f:
+            pickle.dump(model, f)
+
+        opt = ATPEOptimizer(model_dir=str(tmp_path))
+        assert "gamma" in opt.models
+        meta = opt.predict_meta(feats)
+        assert meta["gamma"] == pytest.approx(0.33, abs=0.01)
+
+    def test_lock_choice(self):
+        rng = np.random.default_rng(0)
+        corr = {"good": 0.9, "bad": 0.01, "worse": 0.0}
+        locked = ATPEOptimizer.choose_locks(corr, cutoff=0.1, rng=rng)
+        assert "good" not in locked
+
+
+class TestSuggest:
+    def test_startup_random(self):
+        d = domains.get("quadratic1")
+        domain = Domain(d.fn, d.space)
+        docs = atpe.suggest([0], domain, Trials(), seed=0)
+        assert len(docs) == 1
+
+    def test_runs_on_mixed_space(self):
+        d = domains.get("many_dists")
+        trials = Trials()
+        fmin(
+            d.fn, d.space, algo=atpe.suggest, max_evals=45, trials=trials,
+            rstate=np.random.default_rng(0), show_progressbar=False, verbose=False,
+        )
+        assert len(trials) == 45
+
+    def test_quality_on_quadratic(self):
+        d = domains.get("quadratic1")
+        trials = Trials()
+        fmin(
+            d.fn, d.space, algo=atpe.suggest, max_evals=d.quality_evals,
+            trials=trials, rstate=np.random.default_rng(5),
+            show_progressbar=False, verbose=False,
+        )
+        assert min(trials.losses()) < d.quality_threshold
+
+    def test_deterministic(self):
+        d = domains.get("branin")
+        trials = seeded_trials(d)
+        domain = Domain(d.fn, d.space)
+        a = atpe.suggest([100], domain, trials, seed=9)
+        b = atpe.suggest([100], domain, trials, seed=9)
+        assert a[0]["misc"]["vals"] == b[0]["misc"]["vals"]
