@@ -129,6 +129,9 @@ class FMinIter:
         self.early_stop_fn = early_stop_fn
         self.early_stop_args = []
         self.trials_save_file = trials_save_file
+        from .observability import PhaseTimings
+
+        self.timings = PhaseTimings()
 
         if self.asynchronous:
             if "FMinIter_Domain" not in trials.attachments:
@@ -230,12 +233,13 @@ class FMinIter:
                     n_to_enqueue = min(self.max_queue_len - qlen, N - n_queued)
                     new_ids = trials.new_trial_ids(n_to_enqueue)
                     self.trials.refresh()
-                    new_trials = algo(
-                        new_ids,
-                        self.domain,
-                        trials,
-                        self.rstate.integers(2 ** 31 - 1),
-                    )
+                    with self.timings.phase("suggest"):
+                        new_trials = algo(
+                            new_ids,
+                            self.domain,
+                            trials,
+                            self.rstate.integers(2 ** 31 - 1),
+                        )
                     if new_trials is None:
                         stopped = True
                         break
@@ -257,7 +261,8 @@ class FMinIter:
                     time.sleep(self.poll_interval_secs)
                 else:
                     # run the trials synchronously in this process
-                    self.serial_evaluate()
+                    with self.timings.phase("evaluate"):
+                        self.serial_evaluate()
 
                 self.trials.refresh()
                 if self.trials_save_file != "":
@@ -313,6 +318,8 @@ class FMinIter:
             if block_until_done:
                 self.block_until_done()
             self.trials.refresh()
+            if self.verbose:
+                self.timings.log_summary(logging.DEBUG)
             logger.debug("Queue empty, exiting run.")
 
     def exhaust(self):

@@ -1,0 +1,94 @@
+"""Tracing & profiling hooks.
+
+The reference has no built-in tracing (SURVEY.md §5) — only module loggers
+and ``verbose`` flags.  This module goes further, per the survey's rebuild
+note: per-phase driver timings plus ``jax.profiler`` integration so the
+device-side suggest kernels can be traced on real TPUs (view with
+TensorBoard or Perfetto).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from collections import defaultdict
+from functools import wraps
+
+logger = logging.getLogger(__name__)
+
+
+class PhaseTimings:
+    """Accumulated wall-clock per driver phase (suggest / evaluate / ...)."""
+
+    def __init__(self):
+        self._total = defaultdict(float)
+        self._count = defaultdict(int)
+
+    @contextlib.contextmanager
+    def phase(self, name):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self._total[name] += dt
+            self._count[name] += 1
+
+    def record(self, name, seconds):
+        self._total[name] += seconds
+        self._count[name] += 1
+
+    def summary(self):
+        return {
+            name: {
+                "total_s": round(self._total[name], 6),
+                "count": self._count[name],
+                "mean_ms": round(1e3 * self._total[name] / max(self._count[name], 1), 3),
+            }
+            for name in sorted(self._total)
+        }
+
+    def log_summary(self, level=logging.INFO):
+        for name, stats in self.summary().items():
+            logger.log(
+                level,
+                "phase %-12s total %8.3fs  n=%-5d mean %8.3fms",
+                name,
+                stats["total_s"],
+                stats["count"],
+                stats["mean_ms"],
+            )
+
+
+def timed_suggest(algo, timings: PhaseTimings):
+    """Wrap a suggest function so each call lands in ``timings``."""
+
+    @wraps(algo)
+    def wrapper(new_ids, domain, trials, seed, *args, **kwargs):
+        with timings.phase("suggest"):
+            return algo(new_ids, domain, trials, seed, *args, **kwargs)
+
+    return wrapper
+
+
+def traced_suggest(algo, log_dir):
+    """Wrap a suggest function in a ``jax.profiler.trace`` so its device
+    kernels appear in TensorBoard/Perfetto traces under ``log_dir``."""
+    import jax
+
+    @wraps(algo)
+    def wrapper(new_ids, domain, trials, seed, *args, **kwargs):
+        with jax.profiler.trace(str(log_dir)):
+            return algo(new_ids, domain, trials, seed, *args, **kwargs)
+
+    return wrapper
+
+
+@contextlib.contextmanager
+def annotate(name):
+    """Named region visible in device profiles (TraceAnnotation)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

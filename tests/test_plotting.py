@@ -1,0 +1,108 @@
+"""Plotting + observability smoke tests (reference parity: Agg smoke in
+test_plotting.py / test_progress.py).
+"""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu.algos import rand
+from hyperopt_tpu.observability import PhaseTimings, timed_suggest
+from hyperopt_tpu.plotting import (
+    main_plot_histogram,
+    main_plot_history,
+    main_plot_vars,
+)
+
+
+@pytest.fixture(scope="module")
+def run_trials():
+    trials = Trials()
+    space = {
+        "x": hp.uniform("x", -5, 5),
+        "lr": hp.loguniform("lr", np.log(1e-6), np.log(1.0)),
+    }
+    fmin(
+        lambda c: (c["x"] - 3) ** 2 + abs(np.log10(c["lr"]) + 3) * 0.1,
+        space,
+        algo=rand.suggest,
+        max_evals=30,
+        trials=trials,
+        rstate=np.random.default_rng(0),
+        show_progressbar=False,
+        verbose=False,
+    )
+    return trials
+
+
+def test_plot_history(run_trials):
+    fig = main_plot_history(run_trials, do_show=False)
+    assert fig is not None
+    matplotlib.pyplot.close("all")
+
+
+def test_plot_histogram(run_trials):
+    fig = main_plot_histogram(run_trials, do_show=False)
+    assert fig is not None
+    matplotlib.pyplot.close("all")
+
+
+def test_plot_vars_log_detection(run_trials):
+    fig = main_plot_vars(run_trials, do_show=False, colorize_best=3)
+    assert fig is not None
+    axes = fig.get_axes()
+    scales = {ax.get_title(): ax.get_xscale() for ax in axes if ax.get_title()}
+    assert scales.get("lr") == "log"  # spans > 2 decades
+    assert scales.get("x") == "linear"
+    matplotlib.pyplot.close("all")
+
+
+def test_plot_empty_trials():
+    t = Trials()
+    assert main_plot_histogram(t, do_show=False) is None
+    assert main_plot_vars(t, do_show=False) is None
+    matplotlib.pyplot.close("all")
+
+
+def test_phase_timings():
+    pt = PhaseTimings()
+    with pt.phase("suggest"):
+        pass
+    pt.record("evaluate", 0.5)
+    s = pt.summary()
+    assert s["suggest"]["count"] == 1
+    assert s["evaluate"]["total_s"] == 0.5
+
+
+def test_timed_suggest_wrapper():
+    pt = PhaseTimings()
+    calls = []
+
+    def algo(new_ids, domain, trials, seed):
+        calls.append(1)
+        return []
+
+    wrapped = timed_suggest(algo, pt)
+    wrapped([1], None, None, 0)
+    assert calls == [1]
+    assert pt.summary()["suggest"]["count"] == 1
+
+
+def test_fminiter_records_timings():
+    from hyperopt_tpu.fmin import FMinIter
+    from hyperopt_tpu.base import Domain, Trials as T
+
+    domain = Domain(lambda c: c["x"] ** 2, {"x": hp.uniform("x", -1, 1)})
+    trials = T()
+    it = FMinIter(
+        rand.suggest, domain, trials, rstate=np.random.default_rng(0),
+        max_evals=5, show_progressbar=False,
+    )
+    it.exhaust()
+    s = it.timings.summary()
+    assert s["suggest"]["count"] == 5
+    assert s["evaluate"]["count"] >= 1
