@@ -230,6 +230,21 @@ def _prior_for(spec):
 # ---------------------------------------------------------------------
 
 
+def _use_pallas():
+    """Hand-tiled Pallas scorer on real TPUs; XLA/MXU formulation elsewhere.
+
+    Override with HYPEROPT_TPU_SCORER=pallas|xla|exact.
+    """
+    import os
+
+    forced = os.environ.get("HYPEROPT_TPU_SCORER")
+    if forced:
+        return forced
+    import jax
+
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
 def _continuous_best_core(
     key,
     below,
@@ -250,6 +265,9 @@ def _continuous_best_core(
 ):
     import jax.numpy as jnp
 
+    from ..ops.pallas_gmm import pair_score_pallas
+    from ..ops.score import pair_params, pair_score
+
     wb, mb, sb = parzen_ops.adaptive_parzen_normal_padded(
         below, n_below, prior_weight, prior_mu, prior_sigma, lf
     )
@@ -257,9 +275,22 @@ def _continuous_best_core(
         above, n_above, prior_weight, prior_mu, prior_sigma, lf
     )
     cand = gmm_ops.gmm_sample(key, wb, mb, sb, low, high, q, k * n_cand, log_scale)
-    ll_b = gmm_ops.gmm_lpdf(cand, wb, mb, sb, low, high, q, log_scale, quantized)
-    ll_a = gmm_ops.gmm_lpdf(cand, wa, ma, sa, low, high, q, log_scale, quantized)
-    score = (ll_b - ll_a).reshape(k, n_cand)
+    scorer = _use_pallas()
+    if quantized or scorer == "exact":
+        # quantized dists integrate CDF buckets — exact path
+        ll_b = gmm_ops.gmm_lpdf(cand, wb, mb, sb, low, high, q, log_scale, quantized)
+        ll_a = gmm_ops.gmm_lpdf(cand, wa, ma, sa, low, high, q, log_scale, quantized)
+        score = ll_b - ll_a
+    else:
+        # fused pair scorer: p_accept constants and the lognormal Jacobian
+        # are constant / cancel in l−g, so the argmax is unchanged
+        z = jnp.log(jnp.maximum(cand, EPS)) if log_scale else cand
+        params = pair_params(wb, mb, sb, wa, ma, sa)
+        if scorer == "pallas":
+            score = pair_score_pallas(z, params)
+        else:
+            score = pair_score(z, params)
+    score = score.reshape(k, n_cand)
     cand = cand.reshape(k, n_cand)
     best = cand[jnp.arange(k), jnp.argmax(score, axis=1)]
     return best

@@ -1,0 +1,95 @@
+"""Fused l(x)/g(x) score kernels — the MXU formulation.
+
+The TPE score for candidate x is ``log l(x) − log g(x)`` where each term is
+a logsumexp over mixture components of
+``−½((z−μ)/σ)² + log w − log(σ√2π)``.  The quadratic expands to
+
+    comp_ll = z²·(−½inv²) + z·(μ·inv²) + (logcoef − ½μ²·inv²)
+
+i.e. a **rank-3 matmul**: features ``F = [z², z, 1]`` of shape [C, 3]
+against a parameter matrix ``P`` of shape [3, K] — exactly the shape the
+MXU wants.  Both mixtures are concatenated into one ``[3, 2K]`` matrix so
+a single matmul feeds both logsumexps.
+
+The additive constants the suggest path may drop (global ``p_accept``
+normalizers, the lognormal ``−log x`` Jacobian which cancels in l−g) do
+not affect the argmax; ``hyperopt_tpu.ops.gmm.gmm_lpdf`` remains the exact
+normalized density for the public API.
+
+Two implementations with identical semantics:
+- :func:`pair_score` — jnp, chunked over candidates (runs everywhere;
+  XLA maps the matmul to the MXU on TPU);
+- :mod:`hyperopt_tpu.ops.pallas_gmm` — a Pallas kernel with online
+  (flash-style) logsumexp accumulation over component tiles, keeping the
+  whole mixture resident in VMEM.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_LOG_SQRT_2PI = 0.9189385332046727
+NEG_BIG = -1e30
+
+
+def prepare_mixture(w, mu, sigma, eps=1e-12):
+    """Mixture → the 3-row parameter block of the quadratic formulation.
+
+    Zero-weight (padding) components get logcoef = −inf so they contribute
+    exactly 0 mass; their mu/inv entries are finite so no NaNs arise.
+    """
+    sigma = jnp.maximum(sigma, eps)
+    inv = 1.0 / sigma
+    inv2 = inv * inv
+    logcoef = jnp.where(
+        w > 0, jnp.log(jnp.maximum(w, eps)) - jnp.log(sigma) - _LOG_SQRT_2PI, -jnp.inf
+    )
+    # rows: coefficient of z², coefficient of z, constant
+    return jnp.stack([-0.5 * inv2, mu * inv2, logcoef - 0.5 * mu * mu * inv2])
+
+
+def _features(z):
+    return jnp.stack([z * z, z, jnp.ones_like(z)], axis=1)  # [C, 3]
+
+
+def _logsumexp_rows(comp):
+    m = jnp.max(comp, axis=1)
+    m_safe = jnp.maximum(m, NEG_BIG)
+    s = jnp.sum(jnp.exp(comp - m_safe[:, None]), axis=1)
+    return m_safe + jnp.log(jnp.maximum(s, 1e-300))
+
+
+@partial(jax.jit, static_argnames=("chunk",))
+def pair_score(z, params_pair, chunk=4096):
+    """``log l − log g`` (up to additive constant) for candidates ``z``.
+
+    ``params_pair``: [3, 2K] from :func:`prepare_mixture` of the below
+    mixture concatenated with the above mixture.  Chunked over candidates
+    so the [chunk, 2K] intermediate stays small at 10k+ histories.
+    """
+    C = z.shape[0]
+    K2 = params_pair.shape[1]
+    K = K2 // 2
+
+    def score_block(zb):
+        comp = _features(zb) @ params_pair  # [chunk, 2K] -> MXU
+        return _logsumexp_rows(comp[:, :K]) - _logsumexp_rows(comp[:, K:])
+
+    if C <= chunk:
+        return score_block(z)
+    n_chunks = -(-C // chunk)
+    pad = n_chunks * chunk - C
+    zp = jnp.pad(z, (0, pad)).reshape(n_chunks, chunk)
+    out = jax.lax.map(score_block, zp)
+    return out.reshape(-1)[:C]
+
+
+def pair_params(wb, mb, sb, wa, ma, sa):
+    """Stack both mixtures into the [3, 2K] parameter block (equal K)."""
+    return jnp.concatenate(
+        [prepare_mixture(wb, mb, sb), prepare_mixture(wa, ma, sa)], axis=1
+    )

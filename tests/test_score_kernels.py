@@ -1,0 +1,83 @@
+"""Fused scorer kernels vs the exact lpdf reference (ops.score +
+ops.pallas_gmm in interpreter mode)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from hyperopt_tpu.ops import gmm as gmm_ops
+from hyperopt_tpu.ops.pallas_gmm import pair_score_pallas
+from hyperopt_tpu.ops.score import pair_params, pair_score
+
+
+def make_pair(K=37, seed=0, padded_tail=5):
+    rng = np.random.default_rng(seed)
+    def mk():
+        w = rng.uniform(0.1, 1.0, K).astype(np.float32)
+        if padded_tail:
+            w[-padded_tail:] = 0.0
+        w /= w.sum()
+        mu = rng.normal(0, 2, K).astype(np.float32)
+        s = rng.uniform(0.5, 2.0, K).astype(np.float32)
+        return w, mu, s
+    return mk(), mk()
+
+
+def exact_diff(z, below, above):
+    inf = np.float32(np.inf)
+    args = (np.float32(-inf), inf, np.float32(0.0), False, False)
+    return np.asarray(gmm_ops.gmm_lpdf(z, *below, *args)) - np.asarray(
+        gmm_ops.gmm_lpdf(z, *above, *args)
+    )
+
+
+@pytest.mark.parametrize("C,K", [(100, 8), (1000, 37), (257, 130)])
+def test_xla_scorer_matches_exact(C, K):
+    below, above = make_pair(K=K, padded_tail=min(3, K - 1))
+    z = np.random.default_rng(1).uniform(-4, 4, C).astype(np.float32)
+    ref = exact_diff(z, below, above)
+    got = np.asarray(pair_score(z, pair_params(*below, *above)))
+    np.testing.assert_allclose(got, ref, atol=5e-5)
+
+
+def test_xla_scorer_chunking_invariant():
+    below, above = make_pair(K=21)
+    z = np.random.default_rng(2).uniform(-4, 4, 999).astype(np.float32)
+    P = pair_params(*below, *above)
+    a = np.asarray(pair_score(z, P, chunk=64))
+    b = np.asarray(pair_score(z, P, chunk=4096))
+    np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+@pytest.mark.parametrize("C,K,tc,tk", [(100, 37, 32, 128), (600, 300, 256, 256)])
+def test_pallas_scorer_matches_exact(C, K, tc, tk):
+    below, above = make_pair(K=K, padded_tail=4)
+    z = np.random.default_rng(3).uniform(-4, 4, C).astype(np.float32)
+    ref = exact_diff(z, below, above)
+    got = np.asarray(
+        pair_score_pallas(z, pair_params(*below, *above), tc=tc, tk=tk, interpret=True)
+    )
+    np.testing.assert_allclose(got, ref, atol=5e-5)
+
+
+def test_pallas_handles_component_padding():
+    # K not a multiple of the tile: kernel pads with -inf logcoef
+    below, above = make_pair(K=137, padded_tail=10)
+    z = np.random.default_rng(4).uniform(-4, 4, 64).astype(np.float32)
+    ref = exact_diff(z, below, above)
+    got = np.asarray(
+        pair_score_pallas(
+            z, pair_params(*below, *above), tc=64, tk=128, interpret=True
+        )
+    )
+    np.testing.assert_allclose(got, ref, atol=5e-5)
+
+
+def test_scorer_selection_env(monkeypatch):
+    from hyperopt_tpu.algos.tpe import _use_pallas
+
+    monkeypatch.setenv("HYPEROPT_TPU_SCORER", "exact")
+    assert _use_pallas() == "exact"
+    monkeypatch.delenv("HYPEROPT_TPU_SCORER")
+    assert _use_pallas() in ("xla", "pallas")
