@@ -1,0 +1,110 @@
+"""ctypes loader/builder for the native runtime components.
+
+Native policy (SURVEY.md §2): the reference is pure Python, so no native
+code is required for parity — but the rebuild's control plane gets a C++
+fast path for the FileTrials queue scan (``native/fastqueue.cpp``), built
+on demand with g++ and loaded via ctypes (no pybind11 dependency).  Every
+native entry point has a pure-Python fallback; a build failure degrades
+gracefully to the Python implementation.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+logger = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_REPO_ROOT, "native", "fastqueue.cpp")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "_build")
+_LIB = os.path.join(_BUILD_DIR, "libfastqueue.so")
+
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+
+def _build():
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        "-o", _LIB, _SRC,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+
+
+def load_fastqueue():
+    """The fastqueue library handle, or None if unavailable."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if not os.path.exists(_LIB) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+            ):
+                _build()
+            lib = ctypes.CDLL(_LIB)
+            lib.fq_count_states.restype = ctypes.c_int
+            lib.fq_count_states.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_long),
+            ]
+            lib.fq_list_state.restype = ctypes.c_int
+            lib.fq_list_state.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.c_int,
+            ]
+            lib.fq_try_lock.restype = ctypes.c_int
+            lib.fq_try_lock.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+            _lib = lib
+        except (OSError, subprocess.SubprocessError, FileNotFoundError) as e:
+            logger.info("fastqueue native build unavailable: %s", e)
+            _lib_failed = True
+    return _lib
+
+
+def count_states(trials_dir, n_states=8):
+    """(counts list, n_docs) via the native scanner; None → use Python."""
+    lib = load_fastqueue()
+    if lib is None:
+        return None
+    counts = (ctypes.c_long * n_states)()
+    unparsed = ctypes.c_long(0)
+    n = lib.fq_count_states(
+        trials_dir.encode(), counts, n_states, ctypes.byref(unparsed)
+    )
+    if n < 0 or unparsed.value > 0:
+        return None  # fall back to the exact Python parser
+    return list(counts), n
+
+
+def list_state(trials_dir, state, max_out=1 << 16):
+    lib = load_fastqueue()
+    if lib is None:
+        return None
+    tids = (ctypes.c_long * max_out)()
+    n = lib.fq_list_state(trials_dir.encode(), int(state), tids, max_out)
+    if n < 0:
+        return None
+    return [tids[i] for i in range(n)]
+
+
+def try_lock(lock_path, owner):
+    """1 locked, 0 already locked, None → use the Python primitive."""
+    lib = load_fastqueue()
+    if lib is None:
+        return None
+    r = lib.fq_try_lock(lock_path.encode(), owner.encode())
+    return None if r < 0 else r

@@ -36,11 +36,13 @@ import socket
 import time
 from collections.abc import MutableMapping
 
+from .. import native as _native
 from ..base import (
     JOB_STATE_DONE,
     JOB_STATE_ERROR,
     JOB_STATE_NEW,
     JOB_STATE_RUNNING,
+    JOB_STATES,
     Ctrl,
     Trials,
 )
@@ -153,25 +155,58 @@ class FileJobs:
                 docs.append(doc)
         return docs
 
+    # -- fast queue scan (native C++ with Python fallback) ---------------
+    def count_states(self):
+        """{state: count} over all docs — the poll-loop primitive.
+
+        Uses the native scanner (``native/fastqueue.cpp``) when built; a
+        parse mismatch or missing toolchain falls back to exact parsing.
+        """
+        res = _native.count_states(os.path.join(self.root, "trials"))
+        if res is not None:
+            counts, _ = res
+            return {s: counts[s] for s in JOB_STATES}
+        counts = {s: 0 for s in JOB_STATES}
+        for doc in self.all_docs():
+            counts[doc["state"]] = counts.get(doc["state"], 0) + 1
+        return counts
+
+    def _new_tids(self):
+        tids = _native.list_state(
+            os.path.join(self.root, "trials"), JOB_STATE_NEW
+        )
+        if tids is not None:
+            return tids
+        return [
+            doc["tid"] for doc in self.all_docs() if doc["state"] == JOB_STATE_NEW
+        ]
+
+    def _try_lock(self, lock, owner):
+        r = _native.try_lock(lock, owner)
+        if r is not None:
+            return bool(r)
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        with os.fdopen(fd, "w") as f:
+            f.write(owner)
+        return True
+
     # -- reservation -----------------------------------------------------
     def reserve(self, owner):
         """Atomically claim one JOB_STATE_NEW trial; None if none available.
 
         Exclusive lock-file creation is the only synchronization primitive,
-        exactly as Mongo's atomic owner-stamping is the reference's.
+        exactly as Mongo's atomic owner-stamping is the reference's.  The
+        candidate scan and the lock syscall go through the native fast
+        path when available; the doc rewrite stays in Python (the lock
+        holder owns the doc).
         """
-        for p in sorted(glob.glob(os.path.join(self.root, "trials", "*.json"))):
-            doc = _read_doc(p)
-            if doc is None or doc["state"] != JOB_STATE_NEW:
-                continue
-            lock = self.lock_path(doc["tid"])
-            try:
-                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-            except FileExistsError:
+        for tid in self._new_tids():
+            if not self._try_lock(self.lock_path(tid), owner):
                 continue  # someone else owns it
-            with os.fdopen(fd, "w") as f:
-                f.write(owner)
-            doc = _read_doc(p)  # re-read under the lock
+            doc = _read_doc(self.trial_path(tid))  # re-read under the lock
             if doc is None or doc["state"] != JOB_STATE_NEW:
                 continue
             doc["state"] = JOB_STATE_RUNNING
@@ -298,6 +333,13 @@ class FileTrials(Trials):
         self.refresh()
 
     def count_by_state_unsynced(self, arg):
+        if self._exp_key is None:
+            # poll fast path: native state counting, no doc materialization
+            counts = self.jobs.count_states()
+            if arg in JOB_STATES:
+                return counts.get(arg, 0)
+            if hasattr(arg, "__iter__"):
+                return sum(counts.get(s, 0) for s in arg)
         self.refresh()
         return super().count_by_state_unsynced(arg)
 

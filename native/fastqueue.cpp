@@ -1,0 +1,172 @@
+// fastqueue: native fast path for the FileTrials durable queue.
+//
+// The FileTrials driver/worker poll loop is O(N_trials) per poll: list the
+// trials directory, read each small JSON doc, extract its job state.  In
+// Python that is an open+json.loads per file per poll; at 10k-trial queues
+// polled multiple times a second this dominates the control plane.  This
+// translation unit provides the three hot operations as plain C symbols
+// (loaded via ctypes, no pybind11 needed):
+//
+//   fq_count_states  - one pass over the trials dir, counting docs per
+//                      JOB_STATE (the driver's count_by_state poll)
+//   fq_list_new      - tids of docs currently in JOB_STATE_NEW, sorted
+//                      (the worker's reservation scan)
+//   fq_try_lock      - O_CREAT|O_EXCL lock-file creation stamping the
+//                      owner (THE atomic reservation primitive; identical
+//                      semantics to the Python implementation)
+//
+// Doc writes stay in Python: the lock holder rewrites the JSON doc, so the
+// native layer never has to serialize documents.  State extraction scans
+// for the `"state":` key textually — safe because FileJobs is the only
+// writer and always emits `json.dumps(..., sort_keys=True)` docs.  Any
+// parse miss is reported as state -1 and the Python caller falls back to
+// its exact parser.
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <dirent.h>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+// Read a whole (small) file into buf; returns length or -1.
+long read_file(const char *path, std::vector<char> &buf) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0)
+    return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return -1;
+  }
+  buf.resize(static_cast<size_t>(st.st_size) + 1);
+  long off = 0;
+  while (off < st.st_size) {
+    ssize_t r = read(fd, buf.data() + off, st.st_size - off);
+    if (r <= 0) {
+      close(fd);
+      return -1;
+    }
+    off += r;
+  }
+  close(fd);
+  buf[off] = '\0';
+  return off;
+}
+
+// Extract the integer after a top-level "state": key.  Returns -1 when the
+// pattern is absent/malformed (caller falls back to exact JSON parsing).
+int extract_state(const char *data) {
+  const char *p = strstr(data, "\"state\":");
+  if (!p)
+    return -1;
+  p += 8;
+  while (*p == ' ' || *p == '\t')
+    ++p;
+  if (*p < '0' || *p > '9')
+    return -1;
+  return atoi(p);
+}
+
+// Trial docs are named <tid padded to 12>.json; returns tid or -1.
+long parse_tid(const char *name) {
+  size_t len = strlen(name);
+  if (len < 6 || strcmp(name + len - 5, ".json") != 0)
+    return -1;
+  for (size_t i = 0; i < len - 5; ++i)
+    if (name[i] < '0' || name[i] > '9')
+      return -1;
+  return atol(name);
+}
+
+} // namespace
+
+extern "C" {
+
+// Count docs per state. counts must have room for n_states entries; docs
+// whose state is unparseable or >= n_states land in counts[n_states-1]
+// ... actually they are reported via the return value's sign: we return
+// the number of docs scanned, or -1 on directory errors, and increment
+// *unparsed for fallback detection.
+int fq_count_states(const char *trials_dir, long *counts, int n_states,
+                    long *unparsed) {
+  DIR *d = opendir(trials_dir);
+  if (!d)
+    return -1;
+  for (int i = 0; i < n_states; ++i)
+    counts[i] = 0;
+  *unparsed = 0;
+  int n_docs = 0;
+  std::vector<char> buf;
+  char path[4096];
+  struct dirent *e;
+  while ((e = readdir(d)) != nullptr) {
+    if (parse_tid(e->d_name) < 0)
+      continue;
+    snprintf(path, sizeof(path), "%s/%s", trials_dir, e->d_name);
+    if (read_file(path, buf) < 0) {
+      ++*unparsed;
+      continue;
+    }
+    int st = extract_state(buf.data());
+    if (st < 0 || st >= n_states) {
+      ++*unparsed;
+      continue;
+    }
+    ++counts[st];
+    ++n_docs;
+  }
+  closedir(d);
+  return n_docs;
+}
+
+// Collect sorted tids of docs in `want_state`.  Returns count written (at
+// most max_out) or -1 on directory errors.
+int fq_list_state(const char *trials_dir, int want_state, long *tids,
+                  int max_out) {
+  DIR *d = opendir(trials_dir);
+  if (!d)
+    return -1;
+  std::vector<long> found;
+  std::vector<char> buf;
+  char path[4096];
+  struct dirent *e;
+  while ((e = readdir(d)) != nullptr) {
+    long tid = parse_tid(e->d_name);
+    if (tid < 0)
+      continue;
+    snprintf(path, sizeof(path), "%s/%s", trials_dir, e->d_name);
+    if (read_file(path, buf) < 0)
+      continue;
+    if (extract_state(buf.data()) == want_state)
+      found.push_back(tid);
+  }
+  closedir(d);
+  std::sort(found.begin(), found.end());
+  int n = static_cast<int>(found.size());
+  if (n > max_out)
+    n = max_out;
+  for (int i = 0; i < n; ++i)
+    tids[i] = found[i];
+  return n;
+}
+
+// Atomic reservation: exclusive-create the lock file and stamp the owner.
+// Returns 1 on success, 0 if already locked, -1 on other errors.
+int fq_try_lock(const char *lock_path, const char *owner) {
+  int fd = open(lock_path, O_CREAT | O_EXCL | O_WRONLY, 0644);
+  if (fd < 0)
+    return errno == EEXIST ? 0 : -1;
+  size_t len = strlen(owner);
+  ssize_t w = write(fd, owner, len);
+  close(fd);
+  return (w == static_cast<ssize_t>(len)) ? 1 : -1;
+}
+
+} // extern "C"

@@ -1,0 +1,117 @@
+"""Native fastqueue component tests: builds the C++ lib with g++, checks
+parity with the Python implementations, lock exclusivity, and graceful
+fallback."""
+
+import ctypes
+import os
+import threading
+
+import pytest
+
+from hyperopt_tpu import native
+from hyperopt_tpu.base import JOB_STATE_DONE, JOB_STATE_NEW, JOB_STATE_RUNNING
+from hyperopt_tpu.parallel.file_trials import FileJobs
+
+
+def have_toolchain():
+    return native.load_fastqueue() is not None
+
+
+pytestmark = pytest.mark.skipif(
+    not have_toolchain(), reason="g++ toolchain unavailable"
+)
+
+
+def make_doc(tid, state):
+    return {
+        "tid": tid, "state": state, "spec": None,
+        "result": {"status": "new"},
+        "misc": {"tid": tid, "cmd": None, "idxs": {}, "vals": {}},
+        "exp_key": None, "owner": None, "book_time": None, "refresh_time": None,
+    }
+
+
+def test_native_lib_builds_and_loads():
+    lib = native.load_fastqueue()
+    assert lib is not None
+    assert hasattr(lib, "fq_count_states")
+
+
+def test_count_states_matches_python(tmp_path):
+    jobs = FileJobs(str(tmp_path))
+    for tid, state in enumerate([0, 0, 2, 2, 2, 1, 4]):
+        jobs.insert(make_doc(tid, state))
+    res = native.count_states(os.path.join(str(tmp_path), "trials"))
+    assert res is not None
+    counts, n = res
+    assert n == 7
+    assert counts[JOB_STATE_NEW] == 2
+    assert counts[JOB_STATE_DONE] == 3
+    assert counts[JOB_STATE_RUNNING] == 1
+    assert counts[4] == 1
+    # FileJobs.count_states agrees (whichever path it took)
+    assert jobs.count_states()[JOB_STATE_DONE] == 3
+
+
+def test_list_state_sorted(tmp_path):
+    jobs = FileJobs(str(tmp_path))
+    for tid, state in [(5, 0), (2, 0), (9, 2), (1, 0)]:
+        jobs.insert(make_doc(tid, state))
+    tids = native.list_state(os.path.join(str(tmp_path), "trials"), JOB_STATE_NEW)
+    assert tids == [1, 2, 5]
+
+
+def test_try_lock_exclusive(tmp_path):
+    lock = str(tmp_path / "t.lock")
+    assert native.try_lock(lock, "w1") == 1
+    assert native.try_lock(lock, "w2") == 0
+    with open(lock) as f:
+        assert f.read() == "w1"
+
+
+def test_try_lock_race(tmp_path):
+    lock = str(tmp_path / "race.lock")
+    wins = []
+    barrier = threading.Barrier(8)
+
+    def go(i):
+        barrier.wait()
+        if native.try_lock(lock, f"w{i}") == 1:
+            wins.append(i)
+
+    threads = [threading.Thread(target=go, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1
+
+
+def test_reserve_uses_native_and_agrees(tmp_path):
+    jobs = FileJobs(str(tmp_path))
+    for tid in range(5):
+        jobs.insert(make_doc(tid, JOB_STATE_NEW))
+    seen = set()
+    while True:
+        doc = jobs.reserve("worker")
+        if doc is None:
+            break
+        seen.add(doc["tid"])
+        assert doc["state"] == JOB_STATE_RUNNING
+        assert doc["owner"] == "worker"
+    assert seen == {0, 1, 2, 3, 4}
+
+
+def test_unparsed_doc_falls_back(tmp_path):
+    jobs = FileJobs(str(tmp_path))
+    jobs.insert(make_doc(0, JOB_STATE_NEW))
+    # hand-write a doc the textual scanner cannot parse (no "state": int)
+    weird = os.path.join(str(tmp_path), "trials", "000000000099.json")
+    with open(weird, "w") as f:
+        f.write('{"tid": 99, "state"\n:\n0, "misc": {"tid": 99, "cmd": null, '
+                '"idxs": {}, "vals": {}}, "result": {"status": "new"}, '
+                '"spec": null, "exp_key": null, "owner": null, '
+                '"book_time": null, "refresh_time": null}')
+    # native count reports unparsed -> count_states falls back to exact
+    counts = jobs.count_states()
+    assert counts[JOB_STATE_NEW] == 2
