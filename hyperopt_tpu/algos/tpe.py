@@ -321,6 +321,80 @@ def _categorical_best_core(
     return cand[jnp.arange(k), jnp.argmax(score, axis=1)]
 
 
+def _continuous_best_sharded(
+    mesh,
+    key,
+    below,
+    n_below,
+    above,
+    n_above,
+    prior_weight,
+    prior_mu,
+    prior_sigma,
+    low,
+    high,
+    k: int,
+    n_cand: int,
+    lf: int,
+    log_scale: bool,
+):
+    """Mesh-sharded variant of the continuous kernel: candidates over
+    ``dp``, mixture components over ``sp`` (blockwise log-sum-exp with
+    psum/pmax over ICI) — the full-history scaling path
+    (``hyperopt_tpu.parallel.sharding``)."""
+    import jax.numpy as jnp
+
+    from ..parallel.sharding import pad_mixture
+
+    wb, mb, sb = parzen_ops.adaptive_parzen_normal_padded(
+        below, n_below, prior_weight, prior_mu, prior_sigma, lf
+    )
+    wa, ma, sa = parzen_ops.adaptive_parzen_normal_padded(
+        above, n_above, prior_weight, prior_mu, prior_sigma, lf
+    )
+    cand = gmm_ops.gmm_sample(
+        key, wb, mb, sb, low, high, np.float32(0.0), k * n_cand, log_scale
+    )
+    sp = int(mesh.shape["sp"])
+    dp = int(mesh.shape["dp"])
+
+    def _pad_to_sp(w, m, s):
+        k_tot = w.shape[0]
+        k_pad = ((k_tot + sp - 1) // sp) * sp
+        return pad_mixture(np.asarray(w), np.asarray(m), np.asarray(s), k_pad)
+
+    wb, mb, sb = _pad_to_sp(wb, mb, sb)
+    wa, ma, sa = _pad_to_sp(wa, ma, sa)
+    C = k * n_cand
+    C_pad = ((C + dp - 1) // dp) * dp
+    z = jnp.log(jnp.maximum(cand, EPS)) if log_scale else cand
+    z = jnp.pad(z, (0, C_pad - C))
+    scorer = _sharded_scorer_for(mesh)
+    # score in the log domain; bounds are log-space for log dists already
+    score = np.asarray(
+        scorer(
+            np.asarray(z, np.float32), wb, mb, sb, wa, ma, sa,
+            np.float32(low), np.float32(high),
+        )
+    )[:C].reshape(k, n_cand)
+    cand = np.asarray(cand).reshape(k, n_cand)
+    return cand[np.arange(k), np.argmax(score, axis=1)]
+
+
+_sharded_scorers = {}
+
+
+def _sharded_scorer_for(mesh):
+    from ..parallel.sharding import make_sharded_score
+
+    key = id(mesh)
+    fn = _sharded_scorers.get(key)
+    if fn is None:
+        fn = make_sharded_score(mesh)
+        _sharded_scorers[key] = fn
+    return fn
+
+
 _jit_cache = {}
 
 
@@ -371,8 +445,15 @@ def suggest(
     gamma=_default_gamma,
     linear_forgetting=_default_linear_forgetting,
     verbose=True,
+    mesh=None,
 ):
-    """TPE suggest: draw candidates from l(x), rank by log l(x) − log g(x)."""
+    """TPE suggest: draw candidates from l(x), rank by log l(x) − log g(x).
+
+    ``mesh``: an optional ``jax.sharding.Mesh`` (axes ``dp``, ``sp``) —
+    continuous-label scoring is then sharded across devices (candidates
+    over dp, mixture components over sp), e.g.
+    ``partial(tpe.suggest, mesh=default_mesh(), n_EI_candidates=65536)``.
+    """
     import jax
 
     hist = trials.history
@@ -419,6 +500,27 @@ def suggest(
             pa = parzen_ops.bucket(len(a_fit))
             b_buf, nb = _pad(b_fit, pb)
             a_buf, na = _pad(a_fit, pa)
+            if mesh is not None and not quantized:
+                best = _continuous_best_sharded(
+                    mesh,
+                    label_keys[ki],
+                    b_buf,
+                    nb,
+                    a_buf,
+                    na,
+                    np.float32(prior_weight),
+                    np.float32(prior_mu),
+                    np.float32(prior_sigma),
+                    np.float32(low),
+                    np.float32(high),
+                    k=k,
+                    n_cand=int(n_EI_candidates),
+                    lf=lf,
+                    log_scale=log_scale,
+                )
+                best = np.asarray(best, dtype=np.float64)
+                chosen_vals[label] = best
+                continue
             best = _continuous_best(
                 label_keys[ki],
                 b_buf,

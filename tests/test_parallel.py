@@ -196,3 +196,43 @@ class TestGraftEntry:
 
         g.dryrun_multichip(8)
         assert "dryrun_multichip ok" in capsys.readouterr().out
+
+
+class TestShardedSuggest:
+    def test_tpe_suggest_with_mesh(self):
+        """tpe.suggest(mesh=...) shards scoring and still yields valid,
+        quality-comparable suggestions."""
+        from functools import partial
+
+        from hyperopt_tpu.parallel.sharding import default_mesh
+
+        d = domains.get("branin")
+        trials = Trials()
+        fmin(
+            d.fn, d.space, algo=rand.suggest, max_evals=30, trials=trials,
+            rstate=np.random.default_rng(0), show_progressbar=False, verbose=False,
+        )
+        from hyperopt_tpu import Domain
+
+        mesh = default_mesh()
+        domain = Domain(d.fn, d.space)
+        docs = tpe.suggest([100, 101], domain, trials, seed=4, mesh=mesh)
+        assert len(docs) == 2
+        for doc in docs:
+            assert -5.0 <= doc["misc"]["vals"]["x"][0] <= 10.0
+            assert 0.0 <= doc["misc"]["vals"]["y"][0] <= 15.0
+
+    def test_sharded_fmin_quality(self):
+        from functools import partial
+
+        from hyperopt_tpu.parallel.sharding import default_mesh
+
+        d = domains.get("quadratic1")
+        algo = partial(tpe.suggest, mesh=default_mesh(), n_startup_jobs=10)
+        trials = Trials()
+        fmin(
+            d.fn, d.space, algo=algo, max_evals=40, trials=trials,
+            rstate=np.random.default_rng(0), show_progressbar=False, verbose=False,
+        )
+        assert len(trials) == 40
+        assert min(trials.losses()) < 0.5
