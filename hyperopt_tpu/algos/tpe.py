@@ -286,10 +286,11 @@ def _continuous_best_core(
         # are constant / cancel in l−g, so the argmax is unchanged
         z = jnp.log(jnp.maximum(cand, EPS)) if log_scale else cand
         params = pair_params(wb, mb, sb, wa, ma, sa)
+        k_below = wb.shape[0]
         if scorer == "pallas":
-            score = pair_score_pallas(z, params)
+            score = pair_score_pallas(z, params, k_below)
         else:
-            score = pair_score(z, params)
+            score = pair_score(z, params, k_below)
     score = score.reshape(k, n_cand)
     cand = cand.reshape(k, n_cand)
     best = cand[jnp.arange(k), jnp.argmax(score, axis=1)]
@@ -395,7 +396,84 @@ def _sharded_scorer_for(mesh):
     return fn
 
 
+def _continuous_family_core(
+    keys,
+    below,
+    n_below,
+    above,
+    n_above,
+    prior_weight,
+    prior_mu,
+    prior_sigma,
+    low,
+    high,
+    q,
+    k: int,
+    n_cand: int,
+    lf: int,
+    log_scale: bool,
+    quantized: bool,
+    scorer: str,
+):
+    """Label-stacked continuous kernel: all L labels of one distribution
+    family (same log/quantization semantics, shared padding bucket) fit,
+    sample, and score in ONE device program — vmapped fits/sampling plus
+    either the batched Pallas scorer or a vmapped XLA scorer."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.pallas_gmm import pair_score_pallas_batched
+    from ..ops.score import pair_params, pair_score
+
+    L = below.shape[0]
+
+    def fit_sample(key, b, nb, a, na, pm, psig, lo, hi, qq):
+        wb, mb, sb = parzen_ops.adaptive_parzen_normal_padded(
+            b, nb, prior_weight, pm, psig, lf
+        )
+        wa, ma, sa = parzen_ops.adaptive_parzen_normal_padded(
+            a, na, prior_weight, pm, psig, lf
+        )
+        cand = gmm_ops.gmm_sample(key, wb, mb, sb, lo, hi, qq, k * n_cand, log_scale)
+        return cand, (wb, mb, sb), (wa, ma, sa)
+
+    cands, B, A = jax.vmap(fit_sample)(
+        keys, below, n_below, above, n_above, prior_mu, prior_sigma, low, high, q
+    )
+    if quantized or scorer == "exact":
+        def score_one(cand, wb, mb, sb, wa, ma, sa, lo, hi, qq):
+            return gmm_ops.gmm_lpdf(
+                cand, wb, mb, sb, lo, hi, qq, log_scale, quantized
+            ) - gmm_ops.gmm_lpdf(cand, wa, ma, sa, lo, hi, qq, log_scale, quantized)
+
+        score = jax.vmap(score_one)(cands, *B, *A, low, high, q)
+    else:
+        z = jnp.log(jnp.maximum(cands, EPS)) if log_scale else cands
+        params = jax.vmap(pair_params)(*B, *A)  # [L, 3, Kb+Ka]
+        k_below = B[0].shape[1]
+        if scorer == "pallas":
+            score = pair_score_pallas_batched(z, params, k_below)
+        else:
+            score = jax.vmap(partial(pair_score, k_below=k_below))(z, params)
+    score = score.reshape(L, k, n_cand)
+    cands = cands.reshape(L, k, n_cand)
+    idx = jnp.argmax(score, axis=2)  # [L, k]
+    best = jnp.take_along_axis(cands, idx[:, :, None], axis=2)[:, :, 0]
+    return best  # [L, k]
+
+
 _jit_cache = {}
+
+
+def _continuous_family(*args, **statics):
+    import jax
+
+    sig = ("fam",) + tuple(sorted(statics.items()))
+    fn = _jit_cache.get(sig)
+    if fn is None:
+        fn = jax.jit(partial(_continuous_family_core, **statics))
+        _jit_cache[sig] = fn
+    return fn(*args)
 
 
 def _continuous_best(*args, **statics):
@@ -481,6 +559,7 @@ def suggest(
     label_keys = jax.random.split(key, len(specs))
 
     chosen_vals = {}
+    family_items = {}
     for ki, (label, spec) in enumerate(specs.items()):
         tids = hist.idxs.get(label, np.zeros(0, dtype=np.int64))
         obs = np.asarray(hist.vals.get(label, np.zeros(0)), dtype=np.float64)
@@ -496,11 +575,11 @@ def suggest(
                 a_fit = np.log(np.maximum(a_obs, EPS))
             else:
                 b_fit, a_fit = b_obs, a_obs
-            pb = parzen_ops.bucket(len(b_fit))
-            pa = parzen_ops.bucket(len(a_fit))
-            b_buf, nb = _pad(b_fit, pb)
-            a_buf, na = _pad(a_fit, pa)
             if mesh is not None and not quantized:
+                pb = parzen_ops.bucket(len(b_fit))
+                pa = parzen_ops.bucket(len(a_fit))
+                b_buf, nb = _pad(b_fit, pb)
+                a_buf, na = _pad(a_fit, pa)
                 best = _continuous_best_sharded(
                     mesh,
                     label_keys[ki],
@@ -521,28 +600,17 @@ def suggest(
                 best = np.asarray(best, dtype=np.float64)
                 chosen_vals[label] = best
                 continue
-            best = _continuous_best(
-                label_keys[ki],
-                b_buf,
-                nb,
-                a_buf,
-                na,
-                np.float32(prior_weight),
-                np.float32(prior_mu),
-                np.float32(prior_sigma),
-                np.float32(low),
-                np.float32(high),
-                np.float32(q),
-                k=k,
-                n_cand=int(n_EI_candidates),
-                lf=lf,
-                log_scale=log_scale,
-                quantized=quantized,
+            # accumulate for the label-stacked family kernel below
+            family_items.setdefault((log_scale, quantized), []).append(
+                {
+                    "ki": ki,
+                    "label": label,
+                    "spec": spec,
+                    "b_fit": b_fit,
+                    "a_fit": a_fit,
+                    "prior": (prior_mu, prior_sigma, low, high, q),
+                }
             )
-            best = np.asarray(best, dtype=np.float64)
-            if spec.dist == "uniformint":
-                best = best.astype(np.int64)
-            chosen_vals[label] = best
         else:
             # randint / categorical posterior over indices
             upper = spec.upper
@@ -572,6 +640,51 @@ def suggest(
                 lf=lf,
             )
             chosen_vals[label] = np.asarray(best, dtype=np.int64) + offset
+
+    # one fused device program per distribution family (labels stacked):
+    # dispatch count is O(families), not O(labels)
+    scorer = _use_pallas()
+    for (log_scale, quantized), items in family_items.items():
+        L = len(items)
+        pad_b = parzen_ops.bucket(max(len(it["b_fit"]) for it in items))
+        pad_a = parzen_ops.bucket(max(len(it["a_fit"]) for it in items))
+        below = np.zeros((L, pad_b), np.float32)
+        above = np.zeros((L, pad_a), np.float32)
+        nb = np.zeros(L, np.int32)
+        na = np.zeros(L, np.int32)
+        priors = np.zeros((L, 5), np.float32)
+        for i, it in enumerate(items):
+            below[i, : len(it["b_fit"])] = it["b_fit"]
+            above[i, : len(it["a_fit"])] = it["a_fit"]
+            nb[i] = len(it["b_fit"])
+            na[i] = len(it["a_fit"])
+            priors[i] = it["prior"]
+        keys = np.stack([label_keys[it["ki"]] for it in items])
+        best = _continuous_family(
+            keys,
+            below,
+            nb,
+            above,
+            na,
+            np.float32(prior_weight),
+            priors[:, 0],
+            priors[:, 1],
+            priors[:, 2],
+            priors[:, 3],
+            priors[:, 4],
+            k=k,
+            n_cand=int(n_EI_candidates),
+            lf=lf,
+            log_scale=log_scale,
+            quantized=quantized,
+            scorer=scorer,
+        )
+        best = np.asarray(best, dtype=np.float64)  # [L, k]
+        for i, it in enumerate(items):
+            vals_i = best[i]
+            if it["spec"].dist == "uniformint":
+                vals_i = vals_i.astype(np.int64)
+            chosen_vals[it["label"]] = vals_i
 
     # branch activity from the chosen choice values (DNF over conditions)
     active = {}

@@ -4,21 +4,22 @@ The O(candidates × history) hot loop of TPE
 (`log l(x) − log g(x)`, see ``ops.score`` for the quadratic-feature
 formulation) as a hand-tiled TPU kernel:
 
-- grid over candidate tiles (``TC`` per step); the full ``[3, 2K]``
+- grid over candidate tiles (``TC`` per step); the full ``[3, Kb+Ka]``
   parameter block stays **resident in VMEM** across the whole grid (≤ a
   few hundred KB even at 10k-trial history), so HBM traffic is O(C + K)
   instead of O(C·K);
-- per candidate tile: one ``[TC, 3] × [3, TK]`` `pl.dot` per component
-  tile (MXU) followed by a flash-attention-style running
-  (max, sum·exp) update (VPU) — the logsumexp never materializes the
-  [C, K] matrix anywhere;
-- padding components carry ``logcoef = −inf`` (from
-  ``ops.score.prepare_mixture``) and contribute exactly zero mass; the
-  running max starts at −1e30 so all-padding tiles are safe in any order.
+- per candidate tile: one ``[TC, 3] × [3, TK]`` matmul per component tile
+  (MXU) followed by a flash-attention-style running (max, sum·exp) update
+  (VPU) — the logsumexp never materializes the [C, K] matrix anywhere;
+- the below/above mixtures have *different* sizes (below is capped at
+  ``linear_forgetting``; above grows with history), so each region is
+  tiled independently from its static boundary — no wasted columns;
+- padding components carry ``logcoef = −inf`` and contribute exactly zero
+  mass; the running max starts at −1e30 so all-padding tiles are safe in
+  any order.
 
-CPU/testing: pass ``interpret=True`` (Pallas interpreter). Production
-entry point is :func:`pair_score_pallas`; numeric contract is identical
-to ``ops.score.pair_score``.
+CPU/testing: pass ``interpret=True`` (Pallas interpreter).  Numeric
+contract is identical to ``ops.score.pair_score``.
 """
 
 from __future__ import annotations
@@ -33,83 +34,130 @@ from jax.experimental import pallas as pl
 NEG_BIG = -1e30
 
 
-def _kernel(z_ref, p_ref, out_ref, *, K: int, TK: int):
-    """One candidate tile vs all 2K components of both mixtures."""
-    z = z_ref[0, :]  # [TC]
-    TC = z.shape[0]
-    f = jnp.stack([z * z, z, jnp.ones_like(z)], axis=1)  # [TC, 3]
+def _mix_update(comp, m, s):
+    tile_max = jnp.max(comp, axis=1)
+    new_m = jnp.maximum(m, tile_max)
+    s = s * jnp.exp(m - new_m) + jnp.sum(jnp.exp(comp - new_m[:, None]), axis=1)
+    return new_m, s
 
-    n_tiles = K // TK
 
-    def mix_update(comp, m, s):
-        tile_max = jnp.max(comp, axis=1)
-        new_m = jnp.maximum(m, tile_max)
-        s = s * jnp.exp(m - new_m) + jnp.sum(
-            jnp.exp(comp - new_m[:, None]), axis=1
-        )
-        return new_m, s
+def _region_logsumexp(f, p_ref, start: int, size: int, tk: int, lead=None):
+    """Online logsumexp of ``f @ P[:, start:start+size]`` tiled by ``tk``."""
+    TC = f.shape[0]
 
     def body(j, carry):
-        mb, sb, ma, sa = carry
-        pb = p_ref[:, pl.ds(j * TK, TK)]          # below-mixture tile [3, TK]
-        pa = p_ref[:, pl.ds(K + j * TK, TK)]      # above-mixture tile [3, TK]
-        comp_b = jax.lax.dot_general(
-            f, pb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        m, s = carry
+        if lead is None:
+            tile = p_ref[:, pl.ds(start + j * tk, tk)]
+        else:
+            tile = p_ref[lead, :, pl.ds(start + j * tk, tk)]
+        comp = jax.lax.dot_general(
+            f, tile, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        comp_a = jax.lax.dot_general(
-            f, pa, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        mb, sb = mix_update(comp_b, mb, sb)
-        ma, sa = mix_update(comp_a, ma, sa)
-        return mb, sb, ma, sa
+        return _mix_update(comp, m, s)
 
-    init = (
-        jnp.full((TC,), NEG_BIG, jnp.float32),
-        jnp.zeros((TC,), jnp.float32),
-        jnp.full((TC,), NEG_BIG, jnp.float32),
-        jnp.zeros((TC,), jnp.float32),
-    )
-    mb, sb, ma, sa = jax.lax.fori_loop(0, n_tiles, body, init)
-    ll_b = mb + jnp.log(jnp.maximum(sb, 1e-300))
-    ll_a = ma + jnp.log(jnp.maximum(sa, 1e-300))
+    init = (jnp.full((TC,), NEG_BIG, jnp.float32), jnp.zeros((TC,), jnp.float32))
+    m, s = jax.lax.fori_loop(0, size // tk, body, init)
+    return m + jnp.log(jnp.maximum(s, 1e-300))
+
+
+def _kernel(z_ref, p_ref, out_ref, *, KB: int, KA: int, TKB: int, TKA: int):
+    z = z_ref[0, :]
+    f = jnp.stack([z * z, z, jnp.ones_like(z)], axis=1)
+    ll_b = _region_logsumexp(f, p_ref, 0, KB, TKB)
+    ll_a = _region_logsumexp(f, p_ref, KB, KA, TKA)
     out_ref[0, :] = ll_b - ll_a
 
 
-@partial(jax.jit, static_argnames=("tc", "tk", "interpret"))
-def pair_score_pallas(z, params_pair, tc: int = 256, tk: int = 512, interpret=False):
-    """``log l − log g`` for candidates ``z`` ([C]) given ``params_pair``
-    ([3, 2K]); same contract as ``ops.score.pair_score``."""
-    C = z.shape[0]
-    K2 = params_pair.shape[1]
-    assert K2 % 2 == 0
-    K = K2 // 2
+def _kernel_batched(z_ref, p_ref, out_ref, *, KB: int, KA: int, TKB: int, TKA: int):
+    z = z_ref[0, 0, :]
+    f = jnp.stack([z * z, z, jnp.ones_like(z)], axis=1)
+    ll_b = _region_logsumexp(f, p_ref, 0, KB, TKB, lead=0)
+    ll_a = _region_logsumexp(f, p_ref, KB, KA, TKA, lead=0)
+    out_ref[0, 0, :] = ll_b - ll_a
 
-    # pad candidate axis to the tile size, component axis to the K tile
-    tk = min(tk, max(128, K))
-    k_pad = (-K) % tk
-    if k_pad:
-        neg = jnp.full((1, 1), jnp.float32(NEG_BIG))
-        pb = jnp.pad(params_pair[:, :K], ((0, 0), (0, k_pad)))
-        pa = jnp.pad(params_pair[:, K:], ((0, 0), (0, k_pad)))
-        # padded components: zero quadratic/linear terms, -inf constant
-        pb = pb.at[2, K:].set(-jnp.inf)
-        pa = pa.at[2, K:].set(-jnp.inf)
-        params_pair = jnp.concatenate([pb, pa], axis=1)
-        K = K + k_pad
+
+def _region_tile(k: int, tk: int) -> int:
+    """Per-region tile size: at most ``tk``, at least one 128-lane tile."""
+    return min(tk, ((k + 127) // 128) * 128)
+
+
+def _pad_regions(params_pair, k_below: int, tkb: int, tka: int):
+    """Pad each mixture region to a multiple of its tile size with −inf
+    logcoef columns (zero mass).  Works for [3, K] and [L, 3, K] blocks."""
+    kb, ka = k_below, params_pair.shape[-1] - k_below
+    pb_pad = (-kb) % tkb
+    pa_pad = (-ka) % tka
+    below = params_pair[..., :kb]
+    above = params_pair[..., kb:]
+
+    def pad(block, n):
+        if n == 0:
+            return block
+        widths = [(0, 0)] * (block.ndim - 1) + [(0, n)]
+        block = jnp.pad(block, widths)
+        return block.at[..., 2, -n:].set(-jnp.inf)
+
+    return (
+        jnp.concatenate([pad(below, pb_pad), pad(above, pa_pad)], axis=-1),
+        kb + pb_pad,
+        ka + pa_pad,
+    )
+
+
+@partial(jax.jit, static_argnames=("k_below", "tc", "tk", "interpret"))
+def pair_score_pallas(
+    z, params_pair, k_below: int, tc: int = 256, tk: int = 512, interpret=False
+):
+    """``log l − log g`` for candidates ``z`` ([C]); same contract as
+    ``ops.score.pair_score``."""
+    C = z.shape[0]
+    tkb = _region_tile(k_below, tk)
+    tka = _region_tile(params_pair.shape[1] - k_below, tk)
+    params_pair, KB, KA = _pad_regions(params_pair, k_below, tkb, tka)
     c_pad = (-C) % tc
     zp = jnp.pad(z, (0, c_pad))
     n_c = zp.shape[0] // tc
     zp = zp.reshape(n_c, tc)
 
     out = pl.pallas_call(
-        partial(_kernel, K=K, TK=tk),
+        partial(_kernel, KB=KB, KA=KA, TKB=tkb, TKA=tka),
         out_shape=jax.ShapeDtypeStruct((n_c, tc), jnp.float32),
         grid=(n_c,),
         in_specs=[
             pl.BlockSpec((1, tc), lambda i: (i, 0)),
-            pl.BlockSpec((3, 2 * K), lambda i: (0, 0)),  # resident in VMEM
+            pl.BlockSpec((3, KB + KA), lambda i: (0, 0)),  # resident in VMEM
         ],
         out_specs=pl.BlockSpec((1, tc), lambda i: (i, 0)),
         interpret=interpret,
     )(zp, params_pair)
     return out.reshape(-1)[:C]
+
+
+@partial(jax.jit, static_argnames=("k_below", "tc", "tk", "interpret"))
+def pair_score_pallas_batched(
+    z, params_pair, k_below: int, tc: int = 256, tk: int = 512, interpret=False
+):
+    """Label-stacked variant: ``z`` [L, C], ``params_pair`` [L, 3, Kb+Ka]
+    → scores [L, C].  Grid is (labels × candidate tiles)."""
+    L, C = z.shape
+    tkb = _region_tile(k_below, tk)
+    tka = _region_tile(params_pair.shape[2] - k_below, tk)
+    params_pair, KB, KA = _pad_regions(params_pair, k_below, tkb, tka)
+    c_pad = (-C) % tc
+    zp = jnp.pad(z, ((0, 0), (0, c_pad)))
+    n_c = zp.shape[1] // tc
+    zp = zp.reshape(L, n_c, tc)
+
+    out = pl.pallas_call(
+        partial(_kernel_batched, KB=KB, KA=KA, TKB=tkb, TKA=tka),
+        out_shape=jax.ShapeDtypeStruct((L, n_c, tc), jnp.float32),
+        grid=(L, n_c),
+        in_specs=[
+            pl.BlockSpec((1, 1, tc), lambda l, i: (l, i, 0)),
+            pl.BlockSpec((1, 3, KB + KA), lambda l, i: (l, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, tc), lambda l, i: (l, i, 0)),
+        interpret=interpret,
+    )(zp, params_pair)
+    return out.reshape(L, -1)[:, :C]

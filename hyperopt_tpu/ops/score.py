@@ -8,8 +8,11 @@ a logsumexp over mixture components of
 
 i.e. a **rank-3 matmul**: features ``F = [z², z, 1]`` of shape [C, 3]
 against a parameter matrix ``P`` of shape [3, K] — exactly the shape the
-MXU wants.  Both mixtures are concatenated into one ``[3, 2K]`` matrix so
-a single matmul feeds both logsumexps.
+MXU wants.  Both mixtures are concatenated into one ``[3, Kb+Ka]`` matrix
+(the halves may have different sizes — the below mixture is capped at
+``linear_forgetting`` components while above grows with history — so the
+boundary ``k_below`` is carried explicitly) and a single matmul feeds both
+logsumexps.
 
 The additive constants the suggest path may drop (global ``p_accept``
 normalizers, the lognormal ``−log x`` Jacobian which cancels in l−g) do
@@ -52,6 +55,17 @@ def prepare_mixture(w, mu, sigma, eps=1e-12):
     return jnp.stack([-0.5 * inv2, mu * inv2, logcoef - 0.5 * mu * mu * inv2])
 
 
+def pair_params(wb, mb, sb, wa, ma, sa):
+    """Both mixtures stacked into one [3, Kb+Ka] block.
+
+    Returns the parameter block only; the boundary is the static
+    ``wb.shape[0]`` — pass it to the scorers as ``k_below``.
+    """
+    return jnp.concatenate(
+        [prepare_mixture(wb, mb, sb), prepare_mixture(wa, ma, sa)], axis=1
+    )
+
+
 def _features(z):
     return jnp.stack([z * z, z, jnp.ones_like(z)], axis=1)  # [C, 3]
 
@@ -63,21 +77,21 @@ def _logsumexp_rows(comp):
     return m_safe + jnp.log(jnp.maximum(s, 1e-300))
 
 
-@partial(jax.jit, static_argnames=("chunk",))
-def pair_score(z, params_pair, chunk=4096):
+@partial(jax.jit, static_argnames=("k_below", "chunk"))
+def pair_score(z, params_pair, k_below: int, chunk=4096):
     """``log l − log g`` (up to additive constant) for candidates ``z``.
 
-    ``params_pair``: [3, 2K] from :func:`prepare_mixture` of the below
-    mixture concatenated with the above mixture.  Chunked over candidates
-    so the [chunk, 2K] intermediate stays small at 10k+ histories.
+    ``params_pair``: [3, Kb+Ka] from :func:`pair_params`; ``k_below`` is
+    the Kb boundary.  Chunked over candidates so the [chunk, Kb+Ka]
+    intermediate stays small at 10k+ histories.
     """
     C = z.shape[0]
-    K2 = params_pair.shape[1]
-    K = K2 // 2
 
     def score_block(zb):
-        comp = _features(zb) @ params_pair  # [chunk, 2K] -> MXU
-        return _logsumexp_rows(comp[:, :K]) - _logsumexp_rows(comp[:, K:])
+        comp = _features(zb) @ params_pair  # [chunk, Kb+Ka] -> MXU
+        return _logsumexp_rows(comp[:, :k_below]) - _logsumexp_rows(
+            comp[:, k_below:]
+        )
 
     if C <= chunk:
         return score_block(z)
@@ -86,10 +100,3 @@ def pair_score(z, params_pair, chunk=4096):
     zp = jnp.pad(z, (0, pad)).reshape(n_chunks, chunk)
     out = jax.lax.map(score_block, zp)
     return out.reshape(-1)[:C]
-
-
-def pair_params(wb, mb, sb, wa, ma, sa):
-    """Stack both mixtures into the [3, 2K] parameter block (equal K)."""
-    return jnp.concatenate(
-        [prepare_mixture(wb, mb, sb), prepare_mixture(wa, ma, sa)], axis=1
-    )

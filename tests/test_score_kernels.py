@@ -37,7 +37,7 @@ def test_xla_scorer_matches_exact(C, K):
     below, above = make_pair(K=K, padded_tail=min(3, K - 1))
     z = np.random.default_rng(1).uniform(-4, 4, C).astype(np.float32)
     ref = exact_diff(z, below, above)
-    got = np.asarray(pair_score(z, pair_params(*below, *above)))
+    got = np.asarray(pair_score(z, pair_params(*below, *above), K))
     np.testing.assert_allclose(got, ref, atol=5e-5)
 
 
@@ -45,8 +45,8 @@ def test_xla_scorer_chunking_invariant():
     below, above = make_pair(K=21)
     z = np.random.default_rng(2).uniform(-4, 4, 999).astype(np.float32)
     P = pair_params(*below, *above)
-    a = np.asarray(pair_score(z, P, chunk=64))
-    b = np.asarray(pair_score(z, P, chunk=4096))
+    a = np.asarray(pair_score(z, P, 21, chunk=64))
+    b = np.asarray(pair_score(z, P, 21, chunk=4096))
     np.testing.assert_allclose(a, b, atol=1e-6)
 
 
@@ -56,7 +56,7 @@ def test_pallas_scorer_matches_exact(C, K, tc, tk):
     z = np.random.default_rng(3).uniform(-4, 4, C).astype(np.float32)
     ref = exact_diff(z, below, above)
     got = np.asarray(
-        pair_score_pallas(z, pair_params(*below, *above), tc=tc, tk=tk, interpret=True)
+        pair_score_pallas(z, pair_params(*below, *above), K, tc=tc, tk=tk, interpret=True)
     )
     np.testing.assert_allclose(got, ref, atol=5e-5)
 
@@ -68,7 +68,7 @@ def test_pallas_handles_component_padding():
     ref = exact_diff(z, below, above)
     got = np.asarray(
         pair_score_pallas(
-            z, pair_params(*below, *above), tc=64, tk=128, interpret=True
+            z, pair_params(*below, *above), 137, tc=64, tk=128, interpret=True
         )
     )
     np.testing.assert_allclose(got, ref, atol=5e-5)
@@ -81,3 +81,24 @@ def test_scorer_selection_env(monkeypatch):
     assert _use_pallas() == "exact"
     monkeypatch.delenv("HYPEROPT_TPU_SCORER")
     assert _use_pallas() in ("xla", "pallas")
+
+
+def test_pallas_batched_matches_single():
+    rng = np.random.default_rng(5)
+    L, C, K = 3, 200, 50
+    zs, Ps, singles = [], [], []
+    for l in range(L):
+        below, above = make_pair(K=K, seed=l, padded_tail=3)
+        z = rng.uniform(-4, 4, C).astype(np.float32)
+        P = pair_params(*below, *above)
+        zs.append(z)
+        Ps.append(np.asarray(P))
+        singles.append(np.asarray(pair_score_pallas(z, P, K, interpret=True)))
+    from hyperopt_tpu.ops.pallas_gmm import pair_score_pallas_batched
+
+    batched = np.asarray(
+        pair_score_pallas_batched(
+            np.stack(zs), np.stack(Ps), K, tc=64, tk=128, interpret=True
+        )
+    )
+    np.testing.assert_allclose(batched, np.stack(singles), atol=2e-5)
