@@ -268,15 +268,32 @@ class ATPEOptimizer:
 
     # -- parameter locking (the cascade) ---------------------------------
     @staticmethod
-    def choose_locks(per_param_corr, cutoff, rng):
+    def choose_locks(per_param_corr, cutoff, rng, exclude=frozenset()):
         """Lock params whose loss-rank correlation is below ``cutoff`` with
         probability 1/2 each (keeps exploration alive, like the
-        reference's filtered-parameter resampling)."""
+        reference's filtered-parameter resampling).
+
+        ``exclude``: labels that must never be locked — in particular
+        labels that drive conditional branches (a lock there would have to
+        reconcile every dependent child's activity)."""
         locked = []
         for lb, corr in per_param_corr.items():
+            if lb in exclude:
+                continue
             if corr < cutoff and rng.uniform() < 0.5:
                 locked.append(lb)
         return locked
+
+    @staticmethod
+    def condition_driver_labels(domain):
+        """Labels referenced on the left-hand side of any spec's activity
+        conditions (i.e. hp.choice/randint switches with dependents)."""
+        drivers = set()
+        for spec in domain.space.specs.values():
+            for conj in spec.conditions:
+                for name, _val in conj:
+                    drivers.add(name)
+        return frozenset(drivers)
 
 
 def suggest(
@@ -290,7 +307,9 @@ def suggest(
 ):
     """ATPE suggest: featurize → meta-params → TPE with parameter locks."""
     hist = trials.history
-    if len(hist.losses) < n_startup_jobs:
+    # same startup gate as tpe.suggest: all inserted non-error trials
+    # (reference semantics), plus an empty-OK-history guard
+    if len(trials.trials) < n_startup_jobs or len(hist.losses) == 0:
         return rand.suggest(new_ids, domain, trials, seed)
 
     optimizer = ATPEOptimizer(model_dir=model_dir)
@@ -298,10 +317,52 @@ def suggest(
     meta = optimizer.predict_meta(feats)
     rng = np.random.default_rng(seed)
     locked = optimizer.choose_locks(
-        per_param_corr, meta["secondary_cutoff"], rng
+        per_param_corr,
+        meta["secondary_cutoff"],
+        rng,
+        # never auto-lock a branch-driving label: pinning it would freeze
+        # branch exploration whenever its correlation dips below cutoff
+        exclude=ATPEOptimizer.condition_driver_labels(domain),
     )
 
-    docs = tpe.suggest(
+    # Locks are OBSERVATION FILTERS, not value overwrites: each locked
+    # label's history is narrowed to the incumbent's neighborhood before
+    # the Parzen fits (tpe.suggest(param_locks=...)), so the suggestion is
+    # still sampled through the real posterior and conditional-branch
+    # activity stays consistent by construction (the reference's
+    # per-parameter filtering/resampling semantics, ``hyperopt/atpe.py``
+    # ~L300-700, rebuilt as posterior shaping).
+    param_locks = {}
+    if locked:
+        try:
+            best_misc = trials.best_trial["misc"]
+        except Exception:
+            best_misc = None
+        if best_misc is not None:
+            hist = trials.history
+            for lb in locked:
+                best_vals = best_misc["vals"].get(lb)
+                if not best_vals:
+                    continue  # label inactive in the incumbent: no lock
+                center = float(best_vals[0])
+                spec = domain.space.specs[lb]
+                if spec.dist in ("randint", "categorical") or spec.is_integer:
+                    radius = 0.0  # hard pin to the incumbent category
+                else:
+                    obs = np.asarray(hist.vals.get(lb, []), dtype=float)
+                    hp_view = Hyperparameter(lb, spec)
+                    if hp_view.is_log_scale:
+                        # soft-lock radii are log-space for log dists
+                        obs = np.log(np.maximum(obs, 1e-12))
+                    spread = float(obs.std()) if len(obs) > 1 else 0.0
+                    if spread <= 0:
+                        continue
+                    radius = 0.25 * spread
+                param_locks[lb] = (center, radius)
+        if verbose and param_locks:
+            logger.debug("atpe locked params: %s (meta=%s)", sorted(param_locks), meta)
+
+    return tpe.suggest(
         new_ids,
         domain,
         trials,
@@ -310,21 +371,5 @@ def suggest(
         n_startup_jobs=n_startup_jobs,
         n_EI_candidates=meta["n_EI_candidates"],
         gamma=meta["gamma"],
+        param_locks=param_locks or None,
     )
-
-    if locked:
-        # overwrite locked params with the incumbent best trial's values
-        try:
-            best_misc = trials.best_trial["misc"]
-        except Exception:
-            return docs
-        for doc in docs:
-            for lb in locked:
-                if (
-                    doc["misc"]["vals"].get(lb)
-                    and best_misc["vals"].get(lb)
-                ):
-                    doc["misc"]["vals"][lb] = list(best_misc["vals"][lb])
-        if verbose:
-            logger.debug("atpe locked params: %s (meta=%s)", locked, meta)
-    return docs

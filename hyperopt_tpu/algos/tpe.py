@@ -524,6 +524,8 @@ def suggest(
     linear_forgetting=_default_linear_forgetting,
     verbose=True,
     mesh=None,
+    param_locks=None,
+    trial_filter=None,
 ):
     """TPE suggest: draw candidates from l(x), rank by log l(x) − log g(x).
 
@@ -531,12 +533,36 @@ def suggest(
     continuous-label scoring is then sharded across devices (candidates
     over dp, mixture components over sp), e.g.
     ``partial(tpe.suggest, mesh=default_mesh(), n_EI_candidates=65536)``.
+
+    ``param_locks``: optional ``{label: (center, radius)}`` — the ATPE
+    "cascade" (reference ``hyperopt/atpe.py`` ~L300-700) without post-hoc
+    value overwrites:
+
+    - ``radius <= 0``: HARD lock — the label's value is pinned to
+      ``center`` (the reference's ``lockedValues``); the posterior is
+      skipped for it, but branch activity is still derived from the final
+      values, so conditional spaces stay consistent by construction.
+    - ``radius > 0``: SOFT lock — the label's search is confined to the
+      neighborhood: the candidate-sampling bounds are narrowed to
+      ``center ± radius``, the prior recentered there, and the
+      observation sets filtered to the neighborhood before the Parzen
+      fits.  ``center`` is always a raw-space value; for log-scale labels
+      the radius is interpreted in log space (a multiplicative window).
+
+    ``trial_filter``: optional boolean mask aligned with
+    ``trials.history.loss_tids`` (or a callable ``hist -> mask``) —
+    restricts which completed trials feed the posterior (the reference's
+    ``resultFilteringMode`` observation filtering).
     """
     import jax
 
     hist = trials.history
-    n_done = len(hist.losses)
-    if n_done < n_startup_jobs:
+    # Startup gate on ALL inserted non-error trials (reference semantics:
+    # ``len(trials.trials)``), not completed-OK count — with async backends
+    # or STATUS_FAIL results TPE must leave random search at the same point
+    # the reference does.  A separate guard keeps random suggest while the
+    # OK history is empty (nothing to fit a posterior on).
+    if len(trials.trials) < n_startup_jobs or len(hist.losses) == 0:
         return rand.suggest(new_ids, domain, trials, seed)
 
     if not domain.space.compiled:
@@ -549,8 +575,21 @@ def suggest(
     new_ids = list(new_ids)
     k = len(new_ids)
     lf = int(linear_forgetting) if linear_forgetting else 0
+
+    loss_tids, losses = hist.loss_tids, hist.losses
+    if trial_filter is not None:
+        mask = trial_filter(hist) if callable(trial_filter) else trial_filter
+        mask = np.asarray(mask, dtype=bool)
+        if mask.shape != loss_tids.shape:
+            raise ValueError(
+                f"trial_filter mask shape {mask.shape} != history {loss_tids.shape}"
+            )
+        if mask.any():  # an all-False filter would leave nothing to fit
+            loss_tids, losses = loss_tids[mask], losses[mask]
+    kept_tids = loss_tids if trial_filter is not None else None
+
     below_tids = ap_split_trials(
-        hist.loss_tids, hist.losses, gamma, gamma_cap=linear_forgetting
+        loss_tids, losses, gamma, gamma_cap=linear_forgetting
     )
     below_arr = np.fromiter(below_tids, dtype=np.int64, count=len(below_tids))
 
@@ -561,9 +600,26 @@ def suggest(
     chosen_vals = {}
     family_items = {}
     for ki, (label, spec) in enumerate(specs.items()):
-        tids = hist.idxs.get(label, np.zeros(0, dtype=np.int64))
+        tids = np.asarray(hist.idxs.get(label, np.zeros(0, dtype=np.int64)), dtype=np.int64)
         obs = np.asarray(hist.vals.get(label, np.zeros(0)), dtype=np.float64)
-        below_mask = np.isin(np.asarray(tids, dtype=np.int64), below_arr)
+        if kept_tids is not None:
+            keep = np.isin(tids, kept_tids)
+            tids, obs = tids[keep], obs[keep]
+        lock = (param_locks or {}).get(label)
+        if lock is not None and lock[1] <= 0:
+            # hard lock: pin the value, skip the posterior entirely
+            center = lock[0]
+            if spec.is_integer or spec.dist in ("randint", "categorical"):
+                chosen_vals[label] = np.full(k, int(round(center)), np.int64)
+            else:
+                chosen_vals[label] = np.full(k, float(center), np.float64)
+            continue
+        if lock is not None and spec.dist not in _CONTINUOUS:
+            # soft lock on an index label: neighborhood observation filter
+            keep = np.abs(obs - lock[0]) <= lock[1]
+            if keep.any():
+                tids, obs = tids[keep], obs[keep]
+        below_mask = np.isin(tids, below_arr)
         b_obs = obs[below_mask]
         a_obs = obs[~below_mask]
 
@@ -575,6 +631,24 @@ def suggest(
                 a_fit = np.log(np.maximum(a_obs, EPS))
             else:
                 b_fit, a_fit = b_obs, a_obs
+            if lock is not None:
+                # soft lock: confine the search to the neighborhood —
+                # narrowed truncation bounds + recentered prior + filtered
+                # observation sets, all in fit (log if log-scale) space.
+                # A neighborhood disjoint from the label's support would
+                # invert the bounds; ignore the lock instead.
+                center, radius = lock
+                c_fit = (
+                    float(np.log(max(center, EPS))) if log_scale else float(center)
+                )
+                lock_low = max(low, c_fit - radius)
+                lock_high = min(high, c_fit + radius)
+                if lock_low < lock_high:
+                    low, high = lock_low, lock_high
+                    prior_mu = float(np.clip(c_fit, low, high))
+                    prior_sigma = min(prior_sigma, 2.0 * radius)
+                    b_fit = b_fit[np.abs(b_fit - c_fit) <= radius]
+                    a_fit = a_fit[np.abs(a_fit - c_fit) <= radius]
             if mesh is not None and not quantized:
                 pb = parzen_ops.bucket(len(b_fit))
                 pa = parzen_ops.bucket(len(a_fit))

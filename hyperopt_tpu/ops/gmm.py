@@ -38,6 +38,15 @@ def _safe_log(x):
     return jnp.log(jnp.maximum(x, EPS))
 
 
+def _log_weights(w):
+    """Component log-weights with exact-zero weights mapped to -inf.
+
+    Padding components (weight exactly 0, from the padded Parzen fit) must
+    contribute zero mass — ``_safe_log`` alone would give them a spurious
+    ~1e-12 density floor visible deep in the tails."""
+    return jnp.where(w > 0, jnp.log(jnp.maximum(w, EPS)), -jnp.inf)
+
+
 def _cdf(v, mu, sigma):
     """Normal CDF Φ((v−μ)/σ), safe for ±inf v."""
     z = (v - mu) / jnp.maximum(sigma, EPS)
@@ -67,7 +76,7 @@ def gmm_sample(key, w, mu, sigma, low, high, q, n_samples: int, log_scale: bool)
     a = jnp.clip(a, -30.0, 30.0)
     b = jnp.clip(b, -30.0, 30.0)
     Z = ndtr(b) - ndtr(a)
-    comp = jax.random.categorical(k_comp, _safe_log(w * Z), shape=(n_samples,))
+    comp = jax.random.categorical(k_comp, _log_weights(w * Z), shape=(n_samples,))
     u = jax.random.truncated_normal(k_val, a[comp], b[comp])
     x = mu[comp] + sigma[comp] * u
     if log_scale:
@@ -83,7 +92,7 @@ def gmm_lpdf(x, w, mu, sigma, low, high, q, log_scale: bool, quantized: bool):
     The [C, K] broadcast below is the O(candidates × history) hot loop.
     """
     sigma = jnp.maximum(sigma, EPS)
-    logw = _safe_log(w)
+    logw = _log_weights(w)
     p_accept = _p_accept(w, mu, sigma, low, high)
 
     if not quantized:
@@ -148,11 +157,11 @@ def categorical_posterior(obs, n_obs, prior_p, prior_weight, upper: int, lf: int
 
 @partial(jax.jit, static_argnames=("n_samples",))
 def categorical_sample(key, p, n_samples: int):
-    return jax.random.categorical(key, _safe_log(p), shape=(n_samples,)).astype(
+    return jax.random.categorical(key, _log_weights(p), shape=(n_samples,)).astype(
         jnp.int32
     )
 
 
 @jax.jit
 def categorical_lpdf(x, p):
-    return _safe_log(p)[jnp.clip(x.astype(jnp.int32), 0, p.shape[0] - 1)]
+    return _log_weights(p)[jnp.clip(x.astype(jnp.int32), 0, p.shape[0] - 1)]

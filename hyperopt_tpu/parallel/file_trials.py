@@ -181,6 +181,17 @@ class FileJobs:
             doc["tid"] for doc in self.all_docs() if doc["state"] == JOB_STATE_NEW
         ]
 
+    @staticmethod
+    def _unlock_if_owner(lock, owner):
+        try:
+            with open(lock) as f:
+                if f.read() != owner:
+                    return False
+            os.unlink(lock)
+            return True
+        except FileNotFoundError:
+            return False
+
     def _try_lock(self, lock, owner):
         r = _native.try_lock(lock, owner)
         if r is not None:
@@ -208,6 +219,14 @@ class FileJobs:
                 continue  # someone else owns it
             doc = _read_doc(self.trial_path(tid))  # re-read under the lock
             if doc is None or doc["state"] != JOB_STATE_NEW:
+                # Lost a race (e.g. grabbed the lock inside requeue_stale's
+                # unlink->rewrite window while the doc still reads RUNNING).
+                # Release the lock we just created, or the trial would sit
+                # NEW-but-locked forever once the rewrite lands — but only
+                # if the lock file still carries OUR owner string: requeue
+                # may already have unlinked it and another worker recreated
+                # it, and deleting theirs would re-open the double-claim.
+                self._unlock_if_owner(self.lock_path(tid), owner)
                 continue
             doc["state"] = JOB_STATE_RUNNING
             doc["owner"] = owner

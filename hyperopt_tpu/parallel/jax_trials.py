@@ -68,13 +68,19 @@ class JaxTrials(Trials):
         parallelism=None,
         timeout=None,
         loss_threshold=None,
+        trial_timeout=None,
         device_fn=None,
         mesh=None,
         exp_key=None,
         refresh=True,
     ):
+        """``timeout`` is the whole-run budget (SparkTrials semantics: it
+        bounds ``fmin``, not a single trial); ``trial_timeout`` is the
+        per-trial cancellation limit (timeout → ``JOB_STATE_CANCEL``).
+        They are independent knobs."""
         super().__init__(exp_key=exp_key, refresh=refresh)
         validate_timeout(timeout)
+        validate_timeout(trial_timeout)
         validate_loss_threshold(loss_threshold)
         if parallelism is None:
             import jax
@@ -87,6 +93,7 @@ class JaxTrials(Trials):
             parallelism = MAX_CONCURRENT_JOBS_ALLOWED
         self.parallelism = parallelism
         self.timeout = timeout
+        self.trial_timeout = trial_timeout
         self.loss_threshold = loss_threshold
         self.device_fn = device_fn
         self.mesh = mesh
@@ -125,7 +132,7 @@ class JaxTrials(Trials):
             space,
             self,
             parallelism=self.parallelism,
-            trial_timeout=self.timeout,
+            trial_timeout=self.trial_timeout,
             device_fn=self.device_fn,
             mesh=self.mesh,
             catch_eval_exceptions=catch_eval_exceptions,
@@ -191,6 +198,12 @@ class _JaxFMinState:
         self._stop = threading.Event()
         self._thread = None
         self._pool = None
+        # Guards every multi-field trial-doc mutation from worker threads.
+        # Invariant the driver's refresh() relies on: a trial whose state
+        # reads DONE always already has its result written — so result is
+        # assigned before state inside the locked region, and the driver
+        # (reading under the GIL) can never observe DONE-without-result.
+        self._mutate_lock = threading.Lock()
 
     # -- lifecycle -----------------------------------------------------
     def start(self):
@@ -208,14 +221,15 @@ class _JaxFMinState:
     # -- dispatch ------------------------------------------------------
     def _claim_new(self):
         claimed = []
-        for trial in self.trials._dynamic_trials:
-            if trial["state"] == JOB_STATE_NEW:
-                trial["state"] = JOB_STATE_RUNNING
-                now = coarse_utcnow()
-                trial["book_time"] = now
-                trial["refresh_time"] = now
-                trial["owner"] = "jax_trials"
-                claimed.append(trial)
+        with self._mutate_lock:
+            for trial in self.trials._dynamic_trials:
+                if trial["state"] == JOB_STATE_NEW:
+                    now = coarse_utcnow()
+                    trial["book_time"] = now
+                    trial["refresh_time"] = now
+                    trial["owner"] = "jax_trials"
+                    trial["state"] = JOB_STATE_RUNNING
+                    claimed.append(trial)
         return claimed
 
     def _dispatch_loop(self):
@@ -248,8 +262,9 @@ class _JaxFMinState:
                 t.start()
                 t.join(self.trial_timeout)
                 if t.is_alive():
-                    trial["state"] = JOB_STATE_CANCEL
-                    trial["refresh_time"] = coarse_utcnow()
+                    with self._mutate_lock:
+                        trial["refresh_time"] = coarse_utcnow()
+                        trial["state"] = JOB_STATE_CANCEL
                     logger.warning(
                         "trial %s cancelled after %.1fs timeout",
                         trial["tid"],
@@ -263,13 +278,15 @@ class _JaxFMinState:
                 result = self.domain.evaluate(spec, ctrl)
         except Exception as e:
             logger.error("trial %s exception: %s", trial["tid"], e)
-            trial["state"] = JOB_STATE_ERROR
-            trial["misc"]["error"] = (str(type(e)), str(e))
-            trial["refresh_time"] = coarse_utcnow()
+            with self._mutate_lock:
+                trial["misc"]["error"] = (str(type(e)), str(e))
+                trial["refresh_time"] = coarse_utcnow()
+                trial["state"] = JOB_STATE_ERROR
             return
-        trial["result"] = result
-        trial["state"] = JOB_STATE_DONE
-        trial["refresh_time"] = coarse_utcnow()
+        with self._mutate_lock:
+            trial["result"] = result
+            trial["refresh_time"] = coarse_utcnow()
+            trial["state"] = JOB_STATE_DONE
         logger.debug("trial %s done in %.3fs", trial["tid"], timer() - start)
 
     # -- device plane --------------------------------------------------
@@ -296,13 +313,15 @@ class _JaxFMinState:
             losses = np.asarray(self._device_eval(batch))[:b]
         except Exception as e:
             logger.error("device batch failed: %s", e)
-            for trial in trials_batch:
-                trial["state"] = JOB_STATE_ERROR
-                trial["misc"]["error"] = (str(type(e)), str(e))
-                trial["refresh_time"] = coarse_utcnow()
+            with self._mutate_lock:
+                for trial in trials_batch:
+                    trial["misc"]["error"] = (str(type(e)), str(e))
+                    trial["refresh_time"] = coarse_utcnow()
+                    trial["state"] = JOB_STATE_ERROR
             return
         now = coarse_utcnow()
-        for trial, loss in zip(trials_batch, losses):
-            trial["result"] = {"loss": float(loss), "status": STATUS_OK}
-            trial["state"] = JOB_STATE_DONE
-            trial["refresh_time"] = now
+        with self._mutate_lock:
+            for trial, loss in zip(trials_batch, losses):
+                trial["result"] = {"loss": float(loss), "status": STATUS_OK}
+                trial["refresh_time"] = now
+                trial["state"] = JOB_STATE_DONE

@@ -300,29 +300,3 @@ def idxs_vals_from_batch(tids, vals, active, specs):
     return idxs_by_label, vals_by_label
 
 
-# ---------------------------------------------------------------------
-# Reference-compatible helper shim
-# ---------------------------------------------------------------------
-
-
-class VectorizeHelper:
-    """API-compatibility shim over :class:`CompiledSpace`.
-
-    The reference's ``VectorizeHelper`` exposed per-label idxs/vals graph
-    nodes; algorithms here consume :class:`CompiledSpace` directly, but
-    ``Domain`` still publishes ``.params`` / ``.idxs_by_label`` style
-    accessors through this wrapper for drop-in familiarity.
-    """
-
-    def __init__(self, expr):
-        self.space = expr if isinstance(expr, CompiledSpace) else CompiledSpace(expr)
-
-    @property
-    def params(self):
-        return {lb: sp.dist_node for lb, sp in self.space.specs.items()}
-
-    def idxs_by_label(self):
-        return {lb: [] for lb in self.space.specs}
-
-    def vals_by_label(self):
-        return {lb: [] for lb in self.space.specs}

@@ -139,3 +139,54 @@ class TestSuggest:
         a = atpe.suggest([100], domain, trials, seed=9)
         b = atpe.suggest([100], domain, trials, seed=9)
         assert a[0]["misc"]["vals"] == b[0]["misc"]["vals"]
+
+
+class TestConditionalLocking:
+    """Round-1 ADVICE (high): post-hoc lock overwrites on a branch-driving
+    label produced docs whose children contradicted the choice value,
+    crashing Domain.evaluate with garbage-collected inputs.  Locks are now
+    observation filters (tpe.suggest(param_locks=...)) so docs stay
+    consistent by construction."""
+
+    def test_condition_driver_labels(self):
+        d = domains.get("q1_choice")
+        domain = Domain(d.fn, d.space)
+        assert ATPEOptimizer.condition_driver_labels(domain) == {"mode"}
+
+    def test_atpe_fmin_on_conditional_space(self):
+        d = domains.get("q1_choice")
+        trials = Trials()
+        fmin(
+            d.fn, d.space, algo=atpe.suggest, max_evals=60, trials=trials,
+            rstate=np.random.default_rng(3), show_progressbar=False, verbose=False,
+        )
+        assert len(trials) == 60
+
+    def test_locked_branch_driver_keeps_docs_consistent(self):
+        from hyperopt_tpu.algos import tpe
+        from hyperopt_tpu.base import Ctrl, spec_from_misc
+
+        d = domains.get("q1_choice")
+        domain = Domain(d.fn, d.space)
+        trials = seeded_trials(d, n=30, seed=1)
+        # hard-lock the choice driver itself to branch 1
+        docs = tpe.suggest(
+            list(range(1000, 1010)), domain, trials, seed=7,
+            param_locks={"mode": (1.0, 0.0)},
+        )
+        for doc in docs:
+            m = doc["misc"]
+            assert m["vals"]["mode"][0] == 1
+            # branch-1 child active, branch-0 child inactive — consistent
+            assert m["vals"]["xr"] and not m["vals"]["xl"]
+            # and the doc must evaluate cleanly (this crashed pre-fix)
+            res = domain.evaluate(spec_from_misc(m), Ctrl(trials))
+            assert res["status"] == "ok"
+
+    def test_locks_exclude_requested_labels(self):
+        rng = np.random.default_rng(0)
+        corr = {"driver": 0.0, "leaf": 0.0}
+        locked = ATPEOptimizer.choose_locks(
+            corr, cutoff=0.5, rng=rng, exclude=frozenset({"driver"})
+        )
+        assert "driver" not in locked

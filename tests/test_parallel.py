@@ -119,7 +119,7 @@ class TestJaxTrials:
                 time.sleep(5.0)
             return abs(c["x"])
 
-        trials = JaxTrials(parallelism=4, timeout=0.3)
+        trials = JaxTrials(parallelism=4, trial_timeout=0.3)
         fmin(
             sometimes_hangs, {"x": hp.uniform("x", -5, 5)}, algo=rand.suggest,
             max_evals=6, trials=trials, timeout=10,

@@ -231,6 +231,48 @@ class TestSuggest:
             else:
                 assert len(m["idxs"]["xr"]) == 1
 
+    def test_startup_gate_counts_inserted_trials(self):
+        # reference gates on len(trials.trials) (all inserted, non-error),
+        # not completed-OK count: with RUNNING/FAIL trials padding the
+        # store past n_startup_jobs, TPE must leave random search
+        from hyperopt_tpu.base import (
+            JOB_STATE_DONE,
+            JOB_STATE_RUNNING,
+            STATUS_OK,
+            STATUS_RUNNING,
+        )
+
+        d = domains.get("quadratic1")
+        domain = Domain(d.fn, d.space)
+        trials = Trials()
+        docs = []
+        rng = np.random.default_rng(0)
+        for i in range(25):
+            misc = {
+                "tid": i, "cmd": None,
+                "idxs": {"x": [i]}, "vals": {"x": [float(rng.uniform(-5, 5))]},
+            }
+            done = i < 5  # only 5 completed-OK; 20 still running
+            docs.append({
+                "tid": i, "spec": None,
+                "result": (
+                    {"status": STATUS_OK, "loss": float(rng.normal())}
+                    if done else {"status": STATUS_RUNNING}
+                ),
+                "misc": misc,
+                "state": JOB_STATE_DONE if done else JOB_STATE_RUNNING,
+                "owner": None, "book_time": None, "refresh_time": None,
+                "exp_key": None,
+            })
+        trials._insert_trial_docs(docs)
+        trials.refresh()
+        assert len(trials.trials) == 25  # gate input
+        assert len(trials.history.losses) == 5
+        a = tpe.suggest([100], domain, trials, seed=3, n_startup_jobs=20)
+        b = rand.suggest([100], domain, Trials(), seed=3)
+        # past the gate: TPE path, so the draw differs from plain random
+        assert a[0]["misc"]["vals"]["x"] != b[0]["misc"]["vals"]["x"]
+
     def test_partial_config_pattern(self):
         from functools import partial
 
@@ -285,3 +327,86 @@ def test_tpe_beats_random_on_distractor():
     tpe_scores = [best_of(tpe.suggest, s) for s in range(3)]
     rand_scores = [best_of(rand.suggest, s) for s in range(3)]
     assert np.mean(tpe_scores) <= np.mean(rand_scores) + 0.05
+
+
+# ---------------------------------------------------------------------
+# Observation filtering: param_locks + trial_filter (the ATPE cascade
+# plumbing — reference resultFilteringMode / per-param filtering)
+# ---------------------------------------------------------------------
+
+
+def _two_cluster_trials(n_per=20):
+    """History with a good cluster at x≈-5 and a bad cluster at x≈+5."""
+    from hyperopt_tpu.base import JOB_STATE_DONE, STATUS_OK
+
+    rng = np.random.default_rng(0)
+    trials = Trials()
+    docs = []
+    for i in range(2 * n_per):
+        good = i % 2 == 0
+        x = rng.normal(-5.0 if good else 5.0, 0.3)
+        loss = (0.1 if good else 10.0) + rng.normal(0, 0.01)
+        misc = {"tid": i, "cmd": None, "idxs": {"x": [i]}, "vals": {"x": [float(x)]}}
+        docs.append({
+            "tid": i, "spec": None,
+            "result": {"status": STATUS_OK, "loss": float(loss)},
+            "misc": misc, "state": JOB_STATE_DONE,
+            "owner": None, "book_time": None, "refresh_time": None,
+            "exp_key": None,
+        })
+    trials._insert_trial_docs(docs)
+    trials.refresh()
+    return trials
+
+
+class TestObsFiltering:
+    def setup_method(self):
+        self.space = {"x": hp.uniform("x", -10, 10)}
+        self.domain = Domain(lambda c: 0.0, self.space)
+        self.trials = _two_cluster_trials()
+
+    def _suggest_xs(self, **kw):
+        docs = tpe.suggest(
+            list(range(1000, 1016)), self.domain, self.trials, seed=11, **kw
+        )
+        return np.array([d["misc"]["vals"]["x"][0] for d in docs])
+
+    def test_unlocked_follows_good_cluster(self):
+        xs = self._suggest_xs()
+        assert np.median(xs) < 0  # posterior tracks the low-loss cluster
+
+    def test_hard_lock_pins_value(self):
+        # radius <= 0: the reference's lockedValues — value pinned exactly
+        xs = self._suggest_xs(param_locks={"x": (3.21, 0.0)})
+        np.testing.assert_allclose(xs, 3.21)
+
+    def test_soft_lock_concentrates_near_incumbent(self):
+        # radius > 0 with center at the incumbent best: observations are
+        # filtered to the neighborhood, so suggestions concentrate there
+        xs = self._suggest_xs(param_locks={"x": (-5.0, 1.0)})
+        assert np.all(np.abs(xs + 5.0) < 3.0), xs
+
+    def test_soft_lock_outside_support_is_ignored(self):
+        # a neighborhood disjoint from the label's support would invert
+        # the truncation bounds; the lock is ignored instead
+        xs = self._suggest_xs(param_locks={"x": (40.0, 0.5)})
+        assert np.all(np.isfinite(xs))
+        assert np.all(xs >= -10) and np.all(xs <= 10)
+        assert len(np.unique(np.round(xs, 6))) > 1  # not a degenerate point
+
+    def test_trial_filter_mask_restricts_posterior(self):
+        hist = self.trials.history
+        # keep only the bad cluster's trials: posterior must follow it
+        mask = np.array([t % 2 == 1 for t in hist.loss_tids])
+        xs = self._suggest_xs(trial_filter=mask)
+        assert np.median(xs) > 0, xs
+
+    def test_trial_filter_callable(self):
+        xs = self._suggest_xs(
+            trial_filter=lambda h: np.asarray(h.loss_tids) % 2 == 1
+        )
+        assert np.median(xs) > 0, xs
+
+    def test_trial_filter_bad_shape_raises(self):
+        with pytest.raises(ValueError):
+            self._suggest_xs(trial_filter=np.ones(3, dtype=bool))
