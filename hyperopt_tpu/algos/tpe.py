@@ -179,50 +179,12 @@ def ap_split_trials(loss_tids, losses, gamma, gamma_cap=_default_linear_forgetti
 
 
 # ---------------------------------------------------------------------
-# Per-distribution posterior configuration
+# Per-distribution posterior configuration — single source of truth in
+# tpe_device (shared by the host/mesh path here and the device path)
 # ---------------------------------------------------------------------
 
-# dist name -> (log_scale, quantized)
-_CONTINUOUS = {
-    "uniform": (False, False),
-    "quniform": (False, True),
-    "uniformint": (False, True),
-    "loguniform": (True, False),
-    "qloguniform": (True, True),
-    "normal": (False, False),
-    "qnormal": (False, True),
-    "lognormal": (True, False),
-    "qlognormal": (True, True),
-}
-
-
-def _prior_for(spec):
-    """(prior_mu, prior_sigma, low, high, q) for a continuous ParamSpec.
-
-    Mirrors the reference's per-dist posterior builders
-    (``adaptive_parzen_sampler('uniform')`` etc., ~L570-720): uniform-family
-    priors sit mid-support with sigma = support width; normal-family priors
-    are the distribution's own (mu, sigma); log-family works in log space.
-    """
-    p = spec.params
-    d = spec.dist
-    if d in ("uniform", "quniform", "uniformint"):
-        low, high = float(p["low"]), float(p["high"])
-        return (
-            0.5 * (low + high),
-            high - low,
-            low,
-            high,
-            float(p.get("q", 0.0) or 0.0),
-        )
-    if d in ("loguniform", "qloguniform"):
-        low, high = float(p["low"]), float(p["high"])  # log-space bounds
-        return 0.5 * (low + high), high - low, low, high, float(p.get("q", 0.0) or 0.0)
-    if d in ("normal", "qnormal"):
-        return float(p["mu"]), float(p["sigma"]), -np.inf, np.inf, float(p.get("q", 0.0) or 0.0)
-    if d in ("lognormal", "qlognormal"):
-        return float(p["mu"]), float(p["sigma"]), -np.inf, np.inf, float(p.get("q", 0.0) or 0.0)
-    raise ValueError(d)
+from .tpe_device import CONTINUOUS as _CONTINUOUS  # noqa: E402
+from .tpe_device import prior_for as _prior_for  # noqa: E402
 
 
 # ---------------------------------------------------------------------
@@ -512,6 +474,171 @@ def _pad(arr, pad):
 # ---------------------------------------------------------------------
 
 
+def _emit_docs(new_ids, domain, trials, chosen_vals, k):
+    """Branch activity (DNF over chosen choice values) + trial docs."""
+    specs = domain.space.specs
+    active = {}
+    for label, spec in specs.items():
+        if not spec.conditions or any(len(c) == 0 for c in spec.conditions):
+            active[label] = np.ones(k, dtype=bool)
+            continue
+        disj = np.zeros(k, dtype=bool)
+        for conj in spec.conditions:
+            acc = np.ones(k, dtype=bool)
+            for (name, val) in conj:
+                acc &= np.asarray(chosen_vals[name]) == val
+            disj |= acc
+        active[label] = disj
+
+    idxs, vals = idxs_vals_from_batch(new_ids, chosen_vals, active, specs)
+    miscs = [
+        {"tid": tid, "cmd": domain.cmd, "workdir": domain.workdir, "idxs": {}, "vals": {}}
+        for tid in new_ids
+    ]
+    miscs_update_idxs_vals(miscs, idxs, vals)
+    results = [domain.new_result() for _ in new_ids]
+    return trials.new_trial_docs(new_ids, [None] * k, results, miscs)
+
+
+def _suggest_device(
+    new_ids,
+    domain,
+    trials,
+    hist,
+    seed,
+    prior_weight,
+    n_EI_candidates,
+    gamma,
+    linear_forgetting,
+    param_locks,
+    trial_filter,
+):
+    """The production suggest path: device-resident history, one fused XLA
+    program per distribution family, O(k) host↔device traffic per call
+    (see :mod:`hyperopt_tpu.algos.tpe_device`)."""
+    import jax
+
+    from . import tpe_device as td
+
+    new_ids = list(new_ids)
+    k = len(new_ids)
+    lf = int(linear_forgetting) if linear_forgetting else 0
+
+    dh = td.device_history_for(trials, domain.space)
+    dh.sync(hist)
+
+    mask = None
+    if trial_filter is not None:
+        mask = trial_filter(hist) if callable(trial_filter) else trial_filter
+        mask = np.asarray(mask, dtype=bool)
+        if mask.shape != hist.loss_tids.shape:
+            raise ValueError(
+                f"trial_filter mask shape {mask.shape} != history {hist.loss_tids.shape}"
+            )
+        if not mask.any():
+            mask = None
+    n_eff = int(mask.sum()) if mask is not None else len(hist.losses)
+    n_below = int(np.ceil(gamma * np.sqrt(n_eff)))
+    if linear_forgetting is not None:  # ap_split_trials gamma_cap semantics
+        n_below = min(n_below, int(linear_forgetting))
+    cap_b = parzen_ops.bucket(max(n_below, 1))
+    keep_mask = dh.keep_mask(mask)
+
+    key = jax.random.PRNGKey(int(seed))
+    label_keys = np.asarray(jax.random.split(key, dh.n_labels))
+    scorer = _use_pallas()
+    specs = domain.space.specs
+
+    # hard locks: value pinned, posterior skipped (activity still derived)
+    hard = {}
+    if param_locks:
+        for lb, (center, radius) in param_locks.items():
+            if radius <= 0:
+                spec = specs[lb]
+                if spec.is_integer or spec.dist in ("randint", "categorical"):
+                    hard[lb] = np.full(k, int(round(center)), np.int64)
+                else:
+                    hard[lb] = np.full(k, float(center), np.float64)
+
+    chosen_vals = {}
+    for fam in dh.families.values():
+        keys = label_keys[fam.kis]
+        lock_c = np.zeros(fam.L, np.float32)
+        lock_r = np.full(fam.L, np.inf, np.float32)
+        if fam.key[0] == "cont":
+            priors = fam.default_priors
+            if param_locks:
+                priors = priors.copy()
+                for i, lb in enumerate(fam.labels):
+                    lock = param_locks.get(lb)
+                    if lock is None or lock[1] <= 0:
+                        continue
+                    center, radius = lock
+                    c_fit = (
+                        float(np.log(max(center, EPS)))
+                        if fam.log_scale
+                        else float(center)
+                    )
+                    lo = max(float(priors[i, 2]), c_fit - radius)
+                    hi = min(float(priors[i, 3]), c_fit + radius)
+                    if lo < hi:  # neighborhood inside support: narrow
+                        priors[i, 0] = np.clip(c_fit, lo, hi)
+                        priors[i, 1] = min(float(priors[i, 1]), 2.0 * radius)
+                        priors[i, 2], priors[i, 3] = lo, hi
+                        lock_c[i], lock_r[i] = c_fit, radius
+            best = td.family_suggest(
+                keys,
+                fam.obs,
+                fam.pos,
+                fam.counts,
+                dh.losses,
+                keep_mask,
+                np.int32(n_below),
+                np.float32(prior_weight),
+                priors,
+                lock_c,
+                lock_r,
+                cap_b=cap_b,
+                k=k,
+                n_cand=int(n_EI_candidates),
+                lf=lf,
+                log_scale=fam.log_scale,
+                quantized=fam.quantized,
+                scorer=scorer,
+            )
+        else:
+            if param_locks:
+                for i, lb in enumerate(fam.labels):
+                    lock = param_locks.get(lb)
+                    if lock is not None and lock[1] > 0:
+                        lock_c[i] = float(lock[0] - fam.offsets[i])
+                        lock_r[i] = float(lock[1])
+            best = td.index_family_suggest(
+                keys,
+                fam.obs,
+                fam.pos,
+                fam.counts,
+                dh.losses,
+                keep_mask,
+                np.int32(n_below),
+                np.float32(prior_weight),
+                fam.prior_p,
+                lock_c,
+                lock_r,
+                cap_b=cap_b,
+                upper=fam.upper,
+                k=k,
+                n_cand=int(n_EI_candidates),
+                lf=lf,
+            )
+        best = np.asarray(best)  # [L, k] — the only readback
+        for i, lb in enumerate(fam.labels):
+            if lb not in hard:
+                chosen_vals[lb] = fam.from_fit_space(i, best[i])
+    chosen_vals.update(hard)
+    return _emit_docs(new_ids, domain, trials, chosen_vals, k)
+
+
 def suggest(
     new_ids,
     domain,
@@ -571,6 +698,23 @@ def suggest(
             domain.space.compile_error,
         )
         return rand.suggest(new_ids, domain, trials, seed)
+
+    if mesh is None:
+        # production path: device-resident history, one fused program per
+        # distribution family (tpe_device)
+        return _suggest_device(
+            new_ids,
+            domain,
+            trials,
+            hist,
+            seed,
+            prior_weight,
+            n_EI_candidates,
+            gamma,
+            linear_forgetting,
+            param_locks,
+            trial_filter,
+        )
 
     new_ids = list(new_ids)
     k = len(new_ids)
@@ -760,25 +904,4 @@ def suggest(
                 vals_i = vals_i.astype(np.int64)
             chosen_vals[it["label"]] = vals_i
 
-    # branch activity from the chosen choice values (DNF over conditions)
-    active = {}
-    for label, spec in specs.items():
-        if not spec.conditions or any(len(c) == 0 for c in spec.conditions):
-            active[label] = np.ones(k, dtype=bool)
-            continue
-        disj = np.zeros(k, dtype=bool)
-        for conj in spec.conditions:
-            acc = np.ones(k, dtype=bool)
-            for (name, val) in conj:
-                acc &= np.asarray(chosen_vals[name]) == val
-            disj |= acc
-        active[label] = disj
-
-    idxs, vals = idxs_vals_from_batch(new_ids, chosen_vals, active, specs)
-    miscs = [
-        {"tid": tid, "cmd": domain.cmd, "workdir": domain.workdir, "idxs": {}, "vals": {}}
-        for tid in new_ids
-    ]
-    miscs_update_idxs_vals(miscs, idxs, vals)
-    results = [domain.new_result() for _ in new_ids]
-    return trials.new_trial_docs(new_ids, [None] * k, results, miscs)
+    return _emit_docs(new_ids, domain, trials, chosen_vals, k)

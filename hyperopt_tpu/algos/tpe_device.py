@@ -1,0 +1,543 @@
+"""Device-resident TPE suggest plane: the trials history lives on device.
+
+Reference contrast (SURVEY.md §3.2): the reference re-walks the trial
+documents and refits with numpy per label per suggest — O(history) Python
+plus a full host→device round trip of the observation arrays every call.
+Round 1 of this rebuild already fused the math into one XLA program per
+distribution family, but still re-padded and re-uploaded the whole
+per-label history from host numpy on every suggest (SURVEY.md §7's
+warning: "keep the trials SoA on device ... or the 1000× evaporates in
+transfers").
+
+This module closes that gap:
+
+- :class:`DeviceHistory` keeps, per distribution family, label-stacked
+  ``[L, CAP]`` observation buffers (fit-space values), the aligned
+  ``[L, CAP]`` global-row indices, and the ``[CAPT]`` loss vector as
+  **device arrays**, updated incrementally: an append of ``k`` completed
+  trials uploads O(k) scalars, never the history.  Capacities grow in
+  power-of-two buckets, so full re-uploads happen O(log N) times over a
+  run's life.
+- :func:`family_suggest` / :func:`index_family_suggest` are ONE jitted
+  program per family per suggest: γ-split (loss ranks), below/above
+  packing, adaptive-Parzen fits, truncated-GMM candidate draw,
+  O(candidates × components) scoring, and per-id argmax all execute on
+  device; the only things crossing the host boundary per suggest are the
+  ``[L]`` prior scalars and the winning ``[L, k]`` values.
+
+The γ-split semantics match ``tpe.ap_split_trials`` exactly: ranks come
+from a stable argsort of the (float32) loss vector, the below set is the
+first ``n_below`` ranks, and chronological observation order is preserved
+through the packing (stable mask sorts), which the linear-forgetting ramp
+relies on.
+"""
+
+from __future__ import annotations
+
+import time
+import weakref
+from functools import partial
+
+import jax
+import numpy as np
+
+from ..ops import gmm as gmm_ops
+from ..ops import parzen as parzen_ops
+
+EPS = 1e-12
+_BIG = np.float32(np.finfo(np.float32).max)
+
+
+# ---------------------------------------------------------------------
+# Family grouping
+# ---------------------------------------------------------------------
+
+# dist name -> (log_scale, quantized); index dists handled separately
+CONTINUOUS = {
+    "uniform": (False, False),
+    "quniform": (False, True),
+    "uniformint": (False, True),
+    "loguniform": (True, False),
+    "qloguniform": (True, True),
+    "normal": (False, False),
+    "qnormal": (False, True),
+    "lognormal": (True, False),
+    "qlognormal": (True, True),
+}
+
+
+def prior_for(spec):
+    """(prior_mu, prior_sigma, low, high, q) in FIT space for a continuous
+    spec — mirrors the reference's per-dist posterior builders
+    (``adaptive_parzen_sampler('uniform')`` etc., hyperopt/tpe.py ~L570-720).
+    """
+    p = spec.params
+    d = spec.dist
+    q = float(p.get("q", 0.0) or 0.0)
+    if d in ("uniform", "quniform", "uniformint", "loguniform", "qloguniform"):
+        low, high = float(p["low"]), float(p["high"])  # log-space for log dists
+        return 0.5 * (low + high), high - low, low, high, q
+    if d in ("normal", "qnormal", "lognormal", "qlognormal"):
+        return float(p["mu"]), float(p["sigma"]), -np.inf, np.inf, q
+    raise ValueError(d)
+
+
+class _Family:
+    """One label-stacked distribution family and its device buffers."""
+
+    def __init__(self, key, members):
+        # members: list of (label, spec, ki) in space order
+        self.key = key
+        self.labels = [m[0] for m in members]
+        self.specs = [m[1] for m in members]
+        self.kis = [m[2] for m in members]
+        self.L = len(members)
+        self.cap = 0
+        self.obs = None  # [L, cap] f32 device, fit-space values
+        self.pos = None  # [L, cap] i32 device, global history row
+        self.counts_host = [0] * self.L
+        self.counts = None  # [L] i32 device
+
+        if key[0] == "cont":
+            self.log_scale, self.quantized = key[1], key[2]
+            pri = np.array([prior_for(s) for s in self.specs], np.float32)
+            self.default_priors = pri  # [L, 5]: mu, sigma, low, high, q
+            self.offsets = None
+            self.upper = None
+        else:
+            self.log_scale = self.quantized = False
+            self.offsets = np.array(
+                [
+                    int(s.params.get("low", 0)) if s.dist == "randint" else 0
+                    for s in self.specs
+                ],
+                np.int64,
+            )
+            uppers = [int(s.upper) for s in self.specs]
+            self.upper = max(uppers)
+            pp = np.zeros((self.L, self.upper), np.float32)
+            for i, s in enumerate(self.specs):
+                if s.dist == "categorical":
+                    p = np.asarray(s.params["p"], np.float32)
+                    pp[i, : len(p)] = p / p.sum()
+                else:
+                    pp[i, : uppers[i]] = 1.0 / uppers[i]
+            self.prior_p = pp  # [L, U] (zero-padded rows for smaller uppers)
+
+    def to_fit_space(self, label_i, raw_vals):
+        v = np.asarray(raw_vals, np.float64)
+        if self.key[0] == "cont":
+            if self.log_scale:
+                return np.log(np.maximum(v, EPS)).astype(np.float32)
+            return v.astype(np.float32)
+        return (v - self.offsets[label_i]).astype(np.float32)
+
+    def from_fit_space(self, label_i, best):
+        spec = self.specs[label_i]
+        if self.key[0] == "cont":
+            v = np.asarray(best, np.float64)
+            return v.astype(np.int64) if spec.is_integer else v
+        return np.asarray(best, np.int64) + self.offsets[label_i]
+
+
+class DeviceHistory:
+    """Device-resident struct-of-arrays mirror of one Trials history.
+
+    Cached per (trials, space) via :func:`device_history_for`; ``sync``
+    detects append-only growth (the steady state) by prefix comparison and
+    uploads only the delta.
+    """
+
+    def __init__(self, specs):
+        fams = {}
+        for ki, (label, spec) in enumerate(specs.items()):
+            if spec.dist in CONTINUOUS:
+                fkey = ("cont",) + CONTINUOUS[spec.dist]
+            else:
+                fkey = ("idx",)
+            fams.setdefault(fkey, []).append((label, spec, ki))
+        self.families = {k: _Family(k, v) for k, v in fams.items()}
+        self.n_labels = len(specs)
+
+        self.capt = 0
+        self.losses = None  # [CAPT] f32 device, padded +BIG
+        self._n_synced = 0
+        self._loss_tids = np.zeros(0, np.int64)  # host copies for append check
+        self._losses_synced = np.zeros(0, np.float64)
+        self._tid_row = {}
+        # instrumentation (read by bench.py): host->device traffic
+        self.sync_time = 0.0
+        self.bytes_uploaded = 0
+        self.full_rebuilds = 0
+        self._ones = None
+
+    def keep_mask(self, mask):
+        """[CAPT] bool device mask for trial_filter (all-true cached)."""
+        import jax.numpy as jnp
+
+        if mask is None:
+            if self._ones is None or self._ones.shape[0] != self.capt:
+                self._ones = jnp.ones(self.capt, bool)
+            return self._ones
+        buf = np.zeros(self.capt, bool)
+        buf[: len(mask)] = mask
+        return self._upload(buf)
+
+    # -- sync ----------------------------------------------------------
+    def sync(self, hist):
+        t0 = time.perf_counter()
+        n = len(hist.losses)
+        appended = (
+            n >= self._n_synced
+            and np.array_equal(hist.loss_tids[: self._n_synced], self._loss_tids)
+            # losses too: an in-place result mutation keeps the tid prefix
+            # but must invalidate the device copy (equal_nan: NaN losses
+            # are legitimate diverged trials, not changes)
+            and np.array_equal(
+                hist.losses[: self._n_synced], self._losses_synced, equal_nan=True
+            )
+        )
+        if not appended:
+            self._rebuild(hist)
+        elif n > self._n_synced:
+            self._append(hist)
+        self.sync_time += time.perf_counter() - t0
+
+    def _upload(self, arr):
+        import jax.numpy as jnp
+
+        self.bytes_uploaded += arr.nbytes
+        return jnp.asarray(arr)
+
+    def _rebuild(self, hist):
+        self.full_rebuilds += 1
+        n = len(hist.losses)
+        self.capt = parzen_ops.bucket(max(n, 1))
+        buf = np.full(self.capt, _BIG, np.float32)
+        buf[:n] = hist.losses
+        self.losses = self._upload(buf)
+        self._loss_tids = np.array(hist.loss_tids, np.int64)
+        self._losses_synced = np.array(hist.losses, np.float64)
+        self._tid_row = {int(t): i for i, t in enumerate(self._loss_tids)}
+        self._n_synced = n
+
+        for fam in self.families.values():
+            counts = []
+            cols = {}
+            for i, label in enumerate(fam.labels):
+                tids = hist.idxs.get(label, ())
+                vals = hist.vals.get(label, ())
+                counts.append(len(tids))
+                cols[i] = (tids, vals)
+            fam.cap = parzen_ops.bucket(max(max(counts, default=0), 1))
+            obs = np.zeros((fam.L, fam.cap), np.float32)
+            pos = np.zeros((fam.L, fam.cap), np.int32)
+            for i in range(fam.L):
+                tids, vals = cols[i]
+                c = len(tids)
+                if c:
+                    obs[i, :c] = fam.to_fit_space(i, vals)
+                    pos[i, :c] = [self._tid_row[int(t)] for t in tids]
+            fam.counts_host = counts
+            fam.obs = self._upload(obs)
+            fam.pos = self._upload(pos)
+            fam.counts = self._upload(np.asarray(counts, np.int32))
+
+    def _append(self, hist):
+        n = len(hist.losses)
+        if n > self.capt:
+            return self._rebuild(hist)
+        # capacity growth check first (before mutating host state)
+        for fam in self.families.values():
+            for label in fam.labels:
+                if len(hist.idxs.get(label, ())) > fam.cap:
+                    return self._rebuild(hist)
+
+        old_n = self._n_synced
+        d = _delta_bucket(n - old_n)
+        idx = np.full(d, self.capt, np.int32)  # padding rows dropped
+        lvals = np.zeros(d, np.float32)
+        idx[: n - old_n] = np.arange(old_n, n)
+        lvals[: n - old_n] = hist.losses[old_n:]
+        self.bytes_uploaded += idx.nbytes + lvals.nbytes
+        self.losses = _apply_loss_delta(self.losses, idx, lvals)
+        for i, t in enumerate(hist.loss_tids[old_n:]):
+            self._tid_row[int(t)] = old_n + i
+        self._loss_tids = np.array(hist.loss_tids, np.int64)
+        self._losses_synced = np.array(hist.losses, np.float64)
+        self._n_synced = n
+
+        for fam in self.families.values():
+            rows, cols, vals, poss = [], [], [], []
+            for i, label in enumerate(fam.labels):
+                tids = hist.idxs.get(label, ())
+                all_vals = hist.vals.get(label, ())
+                c0 = fam.counts_host[i]
+                c1 = len(tids)
+                if c1 > c0:
+                    fit = fam.to_fit_space(i, np.asarray(all_vals[c0:c1]))
+                    for j in range(c1 - c0):
+                        rows.append(i)
+                        cols.append(c0 + j)
+                        vals.append(fit[j])
+                        poss.append(self._tid_row[int(tids[c0 + j])])
+                fam.counts_host[i] = c1
+            if rows:
+                d = _delta_bucket(len(rows))
+                r = np.full(d, fam.L, np.int32)  # padding rows dropped
+                c = np.zeros(d, np.int32)
+                v = np.zeros(d, np.float32)
+                p = np.zeros(d, np.int32)
+                r[: len(rows)] = rows
+                c[: len(rows)] = cols
+                v[: len(rows)] = vals
+                p[: len(rows)] = poss
+                self.bytes_uploaded += r.nbytes + c.nbytes + v.nbytes + p.nbytes
+                fam.obs, fam.pos = _apply_family_delta(
+                    fam.obs, fam.pos, r, c, v, p
+                )
+                fam.counts = self._upload(np.asarray(fam.counts_host, np.int32))
+
+
+def _delta_bucket(n: int) -> int:
+    """Pad scatter deltas to small power-of-two sizes so the jitted append
+    programs are reused across calls (suggest batch size varies)."""
+    return max(4, 1 << (max(n, 1) - 1).bit_length())
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _apply_family_delta(obs, pos, rows, cols, vals, poss):
+    """Fused append: padded delta entries carry ``rows == L`` (one past the
+    end) and are dropped by the out-of-bounds scatter mode.  Buffers are
+    donated — on TPU the update is in place, no [L, CAP] copy."""
+    obs = obs.at[rows, cols].set(vals, mode="drop")
+    pos = pos.at[rows, cols].set(poss, mode="drop")
+    return obs, pos
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _apply_loss_delta(losses, idx, vals):
+    return losses.at[idx].set(vals, mode="drop")
+
+
+_cache = weakref.WeakKeyDictionary()
+
+
+def device_history_for(trials, space):
+    """The (trials, space)-scoped DeviceHistory, weak-keyed on both sides
+    (no id()-reuse hazards, no unbounded growth)."""
+    per_trials = _cache.get(trials)
+    if per_trials is None:
+        per_trials = weakref.WeakKeyDictionary()
+        _cache[trials] = per_trials
+    dh = per_trials.get(space)
+    if dh is None:
+        dh = DeviceHistory(space.specs)
+        per_trials[space] = dh
+    return dh
+
+
+# ---------------------------------------------------------------------
+# Fused family programs
+# ---------------------------------------------------------------------
+
+
+def _split_pack(
+    obs,
+    pos,
+    count,
+    ranks,
+    keep_mask,
+    n_below,
+    lock_center,
+    lock_radius,
+    cap_b,
+    lock_fallback: bool,
+):
+    """Per-label γ-split + packing, all fixed-shape.
+
+    Returns (below[cap_b], nb, above[CAP], na) with chronological order
+    preserved inside each side (stable mask argsorts)."""
+    import jax.numpy as jnp
+
+    cap = obs.shape[0]
+    i = jnp.arange(cap)
+    valid = i < count
+    row = jnp.clip(pos, 0, ranks.shape[0] - 1)
+    # trial_filter exclusion: filtered trials feed neither l nor g
+    valid = valid & keep_mask[row]
+    # soft-lock neighborhood filter (radius=inf disables).  Host-path
+    # parity: index labels fall back to the unfiltered set when nothing
+    # matches; continuous labels keep the emptied set (prior-only fit
+    # confined to the narrowed bounds).
+    m_lock = jnp.abs(obs - lock_center) <= lock_radius
+    if lock_fallback:
+        m_lock = jnp.where(jnp.any(valid & m_lock), m_lock, True)
+    valid = valid & m_lock
+    obs_rank = ranks[row]
+    below_mask = valid & (obs_rank < n_below)
+    above_mask = valid & ~below_mask
+    perm_b = jnp.argsort(~below_mask, stable=True)
+    below = obs[perm_b][:cap_b]
+    nb = jnp.sum(below_mask).astype(jnp.int32)
+    perm_a = jnp.argsort(~above_mask, stable=True)
+    above = obs[perm_a]
+    na = jnp.sum(above_mask).astype(jnp.int32)
+    return below, jnp.minimum(nb, cap_b), above, na
+
+
+def _loss_ranks(losses, keep_mask):
+    """Stable rank of every history row by loss (filtered rows rank last)."""
+    import jax.numpy as jnp
+
+    capt = losses.shape[0]
+    masked = jnp.where(keep_mask, losses, _BIG)
+    order = jnp.argsort(masked, stable=True)
+    return jnp.zeros(capt, jnp.int32).at[order].set(
+        jnp.arange(capt, dtype=jnp.int32)
+    )
+
+
+def _family_suggest_core(
+    keys,          # [L, 2] u32
+    obs,           # [L, CAP] f32 fit-space
+    pos,           # [L, CAP] i32
+    counts,        # [L] i32
+    losses,        # [CAPT] f32
+    keep_mask,     # [CAPT] bool (trial_filter; all-true when unset)
+    n_below,       # scalar i32
+    prior_weight,  # scalar f32
+    priors,        # [L, 5] f32: mu, sigma, low, high, q
+    lock_center,   # [L] f32 (fit space; 0 when unset)
+    lock_radius,   # [L] f32 (+inf when unset)
+    *,
+    cap_b: int,
+    k: int,
+    n_cand: int,
+    lf: int,
+    log_scale: bool,
+    quantized: bool,
+    scorer: str,
+):
+    """ONE device program: γ-split → pack → Parzen fits → truncated-GMM
+    draw → log l − log g → per-id argmax, stacked over the family's L
+    labels.  Output: winning values [L, k] (fit space)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.pallas_gmm import pair_score_pallas_batched
+    from ..ops.score import pair_params, pair_score
+
+    L = obs.shape[0]
+    ranks = _loss_ranks(losses, keep_mask)
+
+    def fit_sample(key, obs_l, pos_l, count_l, pri, c, r):
+        pm, ps, lo, hi, qq = pri[0], pri[1], pri[2], pri[3], pri[4]
+        below, nb, above, na = _split_pack(
+            obs_l, pos_l, count_l, ranks, keep_mask, n_below, c, r, cap_b,
+            lock_fallback=False,
+        )
+        wb, mb, sb = parzen_ops.adaptive_parzen_normal_padded(
+            below, nb, prior_weight, pm, ps, lf
+        )
+        wa, ma, sa = parzen_ops.adaptive_parzen_normal_padded(
+            above, na, prior_weight, pm, ps, lf
+        )
+        cand = gmm_ops.gmm_sample(key, wb, mb, sb, lo, hi, qq, k * n_cand, log_scale)
+        return cand, (wb, mb, sb), (wa, ma, sa)
+
+    cands, B, A = jax.vmap(fit_sample)(
+        keys, obs, pos, counts, priors, lock_center, lock_radius
+    )
+    lo, hi, qq = priors[:, 2], priors[:, 3], priors[:, 4]
+    if quantized or scorer == "exact":
+        def score_one(cand, wb, mb, sb, wa, ma, sa, lo, hi, qq):
+            return gmm_ops.gmm_lpdf(
+                cand, wb, mb, sb, lo, hi, qq, log_scale, quantized
+            ) - gmm_ops.gmm_lpdf(cand, wa, ma, sa, lo, hi, qq, log_scale, quantized)
+
+        score = jax.vmap(score_one)(cands, *B, *A, lo, hi, qq)
+    else:
+        z = jnp.log(jnp.maximum(cands, EPS)) if log_scale else cands
+        params = jax.vmap(pair_params)(*B, *A)  # [L, 3, Kb+Ka]
+        k_below = B[0].shape[1]
+        if scorer == "pallas":
+            score = pair_score_pallas_batched(z, params, k_below)
+        else:
+            score = jax.vmap(partial(pair_score, k_below=k_below))(z, params)
+    score = score.reshape(L, k, n_cand)
+    cands = cands.reshape(L, k, n_cand)
+    idx = jnp.argmax(score, axis=2)  # [L, k]
+    return jnp.take_along_axis(cands, idx[:, :, None], axis=2)[:, :, 0]
+
+
+def _index_family_suggest_core(
+    keys,          # [L, 2]
+    obs,           # [L, CAP] f32 (category indices)
+    pos,           # [L, CAP] i32
+    counts,        # [L] i32
+    losses,        # [CAPT] f32
+    keep_mask,     # [CAPT] bool
+    n_below,       # scalar i32
+    prior_weight,  # scalar f32
+    prior_p,       # [L, U] f32 (zero-padded rows)
+    lock_center,   # [L] f32
+    lock_radius,   # [L] f32
+    *,
+    cap_b: int,
+    upper: int,
+    k: int,
+    n_cand: int,
+    lf: int,
+):
+    """Index-label (randint/categorical) family as one device program."""
+    import jax
+    import jax.numpy as jnp
+
+    L = obs.shape[0]
+    ranks = _loss_ranks(losses, keep_mask)
+
+    def one(key, obs_l, pos_l, count_l, pp, c, r):
+        below, nb, above, na = _split_pack(
+            obs_l, pos_l, count_l, ranks, keep_mask, n_below, c, r, cap_b,
+            lock_fallback=True,
+        )
+        pb = gmm_ops.categorical_posterior(below, nb, pp, prior_weight, upper, lf)
+        pa = gmm_ops.categorical_posterior(above, na, pp, prior_weight, upper, lf)
+        # zero-prior padding slots must stay zero-probability
+        pb = jnp.where(pp > 0, pb, 0.0)
+        pa = jnp.where(pp > 0, pa, 0.0)
+        cand = gmm_ops.categorical_sample(key, pb, k * n_cand)
+        sc = gmm_ops.categorical_lpdf(cand, pb) - gmm_ops.categorical_lpdf(cand, pa)
+        return cand.reshape(k, n_cand), sc.reshape(k, n_cand)
+
+    cands, score = jax.vmap(one)(
+        keys, obs, pos, counts, prior_p, lock_center, lock_radius
+    )
+    idx = jnp.argmax(score, axis=2)
+    return jnp.take_along_axis(cands, idx[:, :, None], axis=2)[:, :, 0]
+
+
+_jit_cache = {}
+
+
+def family_suggest(*args, **statics):
+    import jax
+
+    sig = ("cont",) + tuple(sorted(statics.items()))
+    fn = _jit_cache.get(sig)
+    if fn is None:
+        fn = jax.jit(partial(_family_suggest_core, **statics))
+        _jit_cache[sig] = fn
+    return fn(*args)
+
+
+def index_family_suggest(*args, **statics):
+    import jax
+
+    sig = ("idx",) + tuple(sorted(statics.items()))
+    fn = _jit_cache.get(sig)
+    if fn is None:
+        fn = jax.jit(partial(_index_family_suggest_core, **statics))
+        _jit_cache[sig] = fn
+    return fn(*args)

@@ -212,47 +212,61 @@ class _TrialsHistory:
 
     def __init__(self):
         self._fingerprint = None
+        self._idxs_lists = {}
+        self._vals_lists = {}
         self.idxs = {}
         self.vals = {}
         self.loss_tids = np.zeros(0, dtype=np.int64)
         self.losses = np.zeros(0, dtype=np.float64)
 
     def maybe_rebuild(self, trials_obj):
-        docs = [
-            t
-            for t in trials_obj._trials
-            if t["state"] == JOB_STATE_DONE
-            and t["result"].get("status") == STATUS_OK
-        ]
-        # fingerprint on the (tid, loss) content, not just the count:
-        # in-place result mutation or a same-count swap must invalidate
-        fp_tids = np.fromiter((t["tid"] for t in docs), dtype=np.int64, count=len(docs))
-        fp_losses = np.fromiter(
-            (float(t["result"].get("loss", np.nan)) for t in docs),
-            dtype=np.float64,
-            count=len(docs),
-        )
-        fingerprint = (len(docs), fp_tids.tobytes(), fp_losses.tobytes())
-        if fingerprint == self._fingerprint:
-            return
-        self._fingerprint = fingerprint
-        loss_tids, losses = [], []
-        idxs = {}
-        vals = {}
-        for t in docs:
+        # One pass over the docs collects the completed-OK (tid, loss)
+        # pairs; they double as the change fingerprint.  In the steady
+        # state (history grew by k trials) the per-label SoA columns are
+        # extended by the k new docs only — the reference re-walks every
+        # document per suggest (``miscs_to_idxs_vals``); rebuilding from
+        # scratch here would quietly reintroduce that O(N) cost per trial.
+        kept, tids, losses = [], [], []
+        for t in trials_obj._trials:
+            if t["state"] != JOB_STATE_DONE or t["result"].get("status") != STATUS_OK:
+                continue
             loss = t["result"].get("loss")
             if loss is None:
                 continue
-            loss_tids.append(t["tid"])
+            kept.append(t)
+            tids.append(t["tid"])
             losses.append(float(loss))
+        fp_tids = np.asarray(tids, dtype=np.int64)
+        fp_losses = np.asarray(losses, dtype=np.float64)
+        fingerprint = (len(kept), fp_tids.tobytes(), fp_losses.tobytes())
+        if fingerprint == self._fingerprint:
+            return
+        self._fingerprint = fingerprint
+
+        n_prev = len(self.loss_tids)
+        append_only = (
+            len(kept) >= n_prev
+            and np.array_equal(fp_tids[:n_prev], self.loss_tids)
+            # equal_nan: NaN losses (diverged trials) are stable content,
+            # not changes — without it every append degrades to a full
+            # O(N) rebuild once any NaN enters the history
+            and np.array_equal(fp_losses[:n_prev], self.losses, equal_nan=True)
+        )
+        if not append_only:
+            self._idxs_lists = {}
+            self._vals_lists = {}
+            n_prev = 0
+        for t in kept[n_prev:]:
             for k, tt in t["misc"]["idxs"].items():
                 if tt:
-                    idxs.setdefault(k, []).append(tt[0])
-                    vals.setdefault(k, []).append(t["misc"]["vals"][k][0])
-        self.loss_tids = np.asarray(loss_tids, dtype=np.int64)
-        self.losses = np.asarray(losses, dtype=np.float64)
-        self.idxs = {k: np.asarray(v, dtype=np.int64) for k, v in idxs.items()}
-        self.vals = {k: np.asarray(v) for k, v in vals.items()}
+                    self._idxs_lists.setdefault(k, []).append(tt[0])
+                    self._vals_lists.setdefault(k, []).append(
+                        t["misc"]["vals"][k][0]
+                    )
+        self.loss_tids = fp_tids
+        self.losses = fp_losses
+        self.idxs = {k: np.asarray(v, dtype=np.int64) for k, v in self._idxs_lists.items()}
+        self.vals = {k: np.asarray(v) for k, v in self._vals_lists.items()}
 
 
 class Trials:

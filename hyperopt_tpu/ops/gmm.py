@@ -69,6 +69,13 @@ def gmm_sample(key, w, mu, sigma, low, high, q, n_samples: int, log_scale: bool)
 
     ``low``/``high`` are (log-space if ``log_scale``) truncation bounds —
     pass ±inf for unbounded.  ``q <= 0`` disables quantization.
+
+    Component selection is inverse-CDF (cumsum + searchsorted), O(n log K)
+    — NOT ``jax.random.categorical``, whose Gumbel trick materializes an
+    [n, K] noise matrix: at a 10k-trial history that is ~10⁸ random draws
+    per suggest and dominates the whole suggest cost.  Zero-probability
+    (padding) components occupy zero-width CDF intervals, which
+    ``side='right'`` search never selects.
     """
     k_comp, k_val = jax.random.split(key)
     a = (low - mu) / jnp.maximum(sigma, EPS)
@@ -76,9 +83,18 @@ def gmm_sample(key, w, mu, sigma, low, high, q, n_samples: int, log_scale: bool)
     a = jnp.clip(a, -30.0, 30.0)
     b = jnp.clip(b, -30.0, 30.0)
     Z = ndtr(b) - ndtr(a)
-    comp = jax.random.categorical(k_comp, _log_weights(w * Z), shape=(n_samples,))
-    u = jax.random.truncated_normal(k_val, a[comp], b[comp])
-    x = mu[comp] + sigma[comp] * u
+    p = jnp.maximum(w * Z, 0.0)
+    cdf = jnp.cumsum(p)
+    total = cdf[-1]
+    u = jax.random.uniform(k_comp, (n_samples,), dtype=cdf.dtype)
+    # clamp strictly below total: f32 rounding of u*total can hit total
+    # exactly, and searchsorted would then step past the last
+    # positive-weight component onto a zero-weight padding slot
+    t = jnp.minimum(u * total, total * (1.0 - 1e-6))
+    comp = jnp.searchsorted(cdf, t, side="right")
+    comp = jnp.clip(comp, 0, w.shape[0] - 1)
+    u2 = jax.random.truncated_normal(k_val, a[comp], b[comp])
+    x = mu[comp] + sigma[comp] * u2
     if log_scale:
         x = jnp.exp(x)
     x = jnp.where(q > 0, jnp.round(x / jnp.maximum(q, EPS)) * q, x)
