@@ -192,10 +192,48 @@ from .tpe_device import prior_for as _prior_for  # noqa: E402
 # ---------------------------------------------------------------------
 
 
+_probed_scorer = None
+
+
+def _pallas_probe() -> bool:
+    """Lower + run a tiny Pallas pair score once; False if Mosaic rejects.
+
+    A lowering failure must demote the process to the XLA scorer instead
+    of taking down every TPE suggest on TPU (a full Mosaic check only
+    happens on real hardware — ``interpret=True`` tests can't catch it).
+    """
+    import jax
+
+    try:
+        import jax.numpy as jnp
+
+        from ..ops.pallas_gmm import pair_score_pallas, pair_score_pallas_batched
+
+        z = jnp.linspace(-1.0, 1.0, 8)
+        p = jnp.zeros((3, 4), jnp.float32).at[2].set(-1.0)
+        jax.block_until_ready(pair_score_pallas(z, p, 2))
+        # the batched kernel has distinct (3D) block specs — probe both
+        jax.block_until_ready(
+            pair_score_pallas_batched(
+                jnp.stack([z, z]), jnp.stack([p, p]), 2
+            )
+        )
+        return True
+    except Exception as exc:  # pragma: no cover - exercised on TPU only
+        logger.warning(
+            "Pallas scorer failed to lower/run on backend %r (%s); "
+            "falling back to the XLA pair scorer",
+            jax.default_backend(),
+            exc,
+        )
+        return False
+
+
 def _use_pallas():
     """Hand-tiled Pallas scorer on real TPUs; XLA/MXU formulation elsewhere.
 
-    Override with HYPEROPT_TPU_SCORER=pallas|xla|exact.
+    Probes the Pallas path once per process and demotes to "xla" if it
+    cannot lower.  Override with HYPEROPT_TPU_SCORER=pallas|xla|exact.
     """
     import os
 
@@ -204,7 +242,12 @@ def _use_pallas():
         return forced
     import jax
 
-    return "pallas" if jax.default_backend() == "tpu" else "xla"
+    if jax.default_backend() != "tpu":
+        return "xla"
+    global _probed_scorer
+    if _probed_scorer is None:
+        _probed_scorer = "pallas" if _pallas_probe() else "xla"
+    return _probed_scorer
 
 
 def _continuous_best_core(

@@ -14,9 +14,24 @@ formulation) as a hand-tiled TPU kernel:
 - the below/above mixtures have *different* sizes (below is capped at
   ``linear_forgetting``; above grows with history), so each region is
   tiled independently from its static boundary — no wasted columns;
-- padding components carry ``logcoef = −inf`` and contribute exactly zero
-  mass; the running max starts at −1e30 so all-padding tiles are safe in
-  any order.
+- padding components carry ``logcoef = NEG_BIG`` (−1e30 — finite, because
+  infinities poison the HIGHEST-precision multi-pass matmul) and
+  contribute exactly zero mass against any real component; the running
+  max starts at −1e30 so all-padding tiles are safe in any order.
+
+Mosaic layout notes (the TPU lowering requires every block's last two
+dims to be multiples of (8, 128) or equal to the array dims):
+
+- the candidate features ``F = [z², z, 1]`` are computed *outside* the
+  kernel (XLA fuses the three elementwise ops into the pad/reshape), so
+  the streamed operand is ``[C_pad, 3]`` with ``(TC, 3)`` blocks —
+  TC is a multiple of 8 and 3 equals the array dim;
+- scores come back as a ``[C_pad, 1]`` column with ``(TC, 1)`` blocks
+  (1 equals the array dim);
+- the parameter block is mapped whole (block dims == array dims) and so
+  stays VMEM-resident across the grid;
+- each mixture region is padded to a multiple of 128 so the in-kernel
+  ``pl.ds`` lane slices are tile-aligned.
 
 CPU/testing: pass ``interpret=True`` (Pallas interpreter).  Numeric
 contract is identical to ``ops.score.pair_score``.
@@ -51,8 +66,15 @@ def _region_logsumexp(f, p_ref, start: int, size: int, tk: int, lead=None):
             tile = p_ref[:, pl.ds(start + j * tk, tk)]
         else:
             tile = p_ref[lead, :, pl.ds(start + j * tk, tk)]
+        # contraction dim is 3 → bandwidth-bound; HIGHEST forces true-f32
+        # passes (default bf16 passes lose ~1e0 absolute on 10k-component
+        # logsumexps, which would randomize the EI argmax)
         comp = jax.lax.dot_general(
-            f, tile, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            f,
+            tile,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
         )
         return _mix_update(comp, m, s)
 
@@ -61,20 +83,18 @@ def _region_logsumexp(f, p_ref, start: int, size: int, tk: int, lead=None):
     return m + jnp.log(jnp.maximum(s, 1e-300))
 
 
-def _kernel(z_ref, p_ref, out_ref, *, KB: int, KA: int, TKB: int, TKA: int):
-    z = z_ref[0, :]
-    f = jnp.stack([z * z, z, jnp.ones_like(z)], axis=1)
+def _kernel(f_ref, p_ref, out_ref, *, KB: int, KA: int, TKB: int, TKA: int):
+    f = f_ref[...]  # [TC, 3]
     ll_b = _region_logsumexp(f, p_ref, 0, KB, TKB)
     ll_a = _region_logsumexp(f, p_ref, KB, KA, TKA)
-    out_ref[0, :] = ll_b - ll_a
+    out_ref[...] = (ll_b - ll_a)[:, None]
 
 
-def _kernel_batched(z_ref, p_ref, out_ref, *, KB: int, KA: int, TKB: int, TKA: int):
-    z = z_ref[0, 0, :]
-    f = jnp.stack([z * z, z, jnp.ones_like(z)], axis=1)
+def _kernel_batched(f_ref, p_ref, out_ref, *, KB: int, KA: int, TKB: int, TKA: int):
+    f = f_ref[0]  # [TC, 3]
     ll_b = _region_logsumexp(f, p_ref, 0, KB, TKB, lead=0)
     ll_a = _region_logsumexp(f, p_ref, KB, KA, TKA, lead=0)
-    out_ref[0, 0, :] = ll_b - ll_a
+    out_ref[...] = (ll_b - ll_a).reshape(out_ref.shape)
 
 
 def _region_tile(k: int, tk: int) -> int:
@@ -83,8 +103,9 @@ def _region_tile(k: int, tk: int) -> int:
 
 
 def _pad_regions(params_pair, k_below: int, tkb: int, tka: int):
-    """Pad each mixture region to a multiple of its tile size with −inf
-    logcoef columns (zero mass).  Works for [3, K] and [L, 3, K] blocks."""
+    """Pad each mixture region to a multiple of its tile size with
+    NEG_BIG logcoef columns (zero mass).  Works for [3, K] and [L, 3, K]
+    blocks."""
     kb, ka = k_below, params_pair.shape[-1] - k_below
     pb_pad = (-kb) % tkb
     pa_pad = (-ka) % tka
@@ -92,17 +113,28 @@ def _pad_regions(params_pair, k_below: int, tkb: int, tka: int):
     above = params_pair[..., kb:]
 
     def pad(block, n):
+        # NEG_BIG, not −inf: infinities break the HIGHEST-precision
+        # multi-pass matmul (see ops.score.prepare_mixture)
         if n == 0:
             return block
         widths = [(0, 0)] * (block.ndim - 1) + [(0, n)]
         block = jnp.pad(block, widths)
-        return block.at[..., 2, -n:].set(-jnp.inf)
+        return block.at[..., 2, -n:].set(NEG_BIG)
 
     return (
         jnp.concatenate([pad(below, pb_pad), pad(above, pa_pad)], axis=-1),
         kb + pb_pad,
         ka + pa_pad,
     )
+
+
+def _features(z, c_pad: int):
+    """[z², z, 1] feature rows, padded along candidates: [C + c_pad, 3]."""
+    f = jnp.stack([z * z, z, jnp.ones_like(z)], axis=-1)
+    if c_pad:
+        widths = [(0, 0)] * (f.ndim - 2) + [(0, c_pad), (0, 0)]
+        f = jnp.pad(f, widths)
+    return f
 
 
 @partial(jax.jit, static_argnames=("k_below", "tc", "tk", "interpret"))
@@ -116,21 +148,20 @@ def pair_score_pallas(
     tka = _region_tile(params_pair.shape[1] - k_below, tk)
     params_pair, KB, KA = _pad_regions(params_pair, k_below, tkb, tka)
     c_pad = (-C) % tc
-    zp = jnp.pad(z, (0, c_pad))
-    n_c = zp.shape[0] // tc
-    zp = zp.reshape(n_c, tc)
+    fp = _features(z, c_pad)  # [C_pad, 3]
+    n_c = fp.shape[0] // tc
 
     out = pl.pallas_call(
         partial(_kernel, KB=KB, KA=KA, TKB=tkb, TKA=tka),
-        out_shape=jax.ShapeDtypeStruct((n_c, tc), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((n_c * tc, 1), jnp.float32),
         grid=(n_c,),
         in_specs=[
-            pl.BlockSpec((1, tc), lambda i: (i, 0)),
+            pl.BlockSpec((tc, 3), lambda i: (i, 0)),
             pl.BlockSpec((3, KB + KA), lambda i: (0, 0)),  # resident in VMEM
         ],
-        out_specs=pl.BlockSpec((1, tc), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((tc, 1), lambda i: (i, 0)),
         interpret=interpret,
-    )(zp, params_pair)
+    )(fp, params_pair)
     return out.reshape(-1)[:C]
 
 
@@ -145,19 +176,18 @@ def pair_score_pallas_batched(
     tka = _region_tile(params_pair.shape[2] - k_below, tk)
     params_pair, KB, KA = _pad_regions(params_pair, k_below, tkb, tka)
     c_pad = (-C) % tc
-    zp = jnp.pad(z, ((0, 0), (0, c_pad)))
-    n_c = zp.shape[1] // tc
-    zp = zp.reshape(L, n_c, tc)
+    fp = _features(z, c_pad)  # [L, C_pad, 3]
+    n_c = fp.shape[1] // tc
 
     out = pl.pallas_call(
         partial(_kernel_batched, KB=KB, KA=KA, TKB=tkb, TKA=tka),
-        out_shape=jax.ShapeDtypeStruct((L, n_c, tc), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((L, n_c * tc, 1), jnp.float32),
         grid=(L, n_c),
         in_specs=[
-            pl.BlockSpec((1, 1, tc), lambda l, i: (l, i, 0)),
+            pl.BlockSpec((1, tc, 3), lambda l, i: (l, i, 0)),
             pl.BlockSpec((1, 3, KB + KA), lambda l, i: (l, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, tc), lambda l, i: (l, i, 0)),
+        out_specs=pl.BlockSpec((1, tc, 1), lambda l, i: (l, i, 0)),
         interpret=interpret,
-    )(zp, params_pair)
+    )(fp, params_pair)
     return out.reshape(L, -1)[:, :C]

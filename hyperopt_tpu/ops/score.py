@@ -42,14 +42,19 @@ NEG_BIG = -1e30
 def prepare_mixture(w, mu, sigma, eps=1e-12):
     """Mixture → the 3-row parameter block of the quadratic formulation.
 
-    Zero-weight (padding) components get logcoef = −inf so they contribute
-    exactly 0 mass; their mu/inv entries are finite so no NaNs arise.
+    Zero-weight (padding) components get logcoef = NEG_BIG (−1e30, finite
+    — see the comment below) so they contribute exactly 0 mass against any
+    real component; their mu/inv entries are finite so no NaNs arise.
     """
     sigma = jnp.maximum(sigma, eps)
     inv = 1.0 / sigma
     inv2 = inv * inv
+    # NEG_BIG (finite) instead of −inf: infinities poison the HIGHEST-
+    # precision multi-pass matmul (hi/lo operand splits hit inf−inf=NaN);
+    # a −1e30 logcoef still contributes exp(−1e30 − m) = 0 exactly
+    # against any real component.
     logcoef = jnp.where(
-        w > 0, jnp.log(jnp.maximum(w, eps)) - jnp.log(sigma) - _LOG_SQRT_2PI, -jnp.inf
+        w > 0, jnp.log(jnp.maximum(w, eps)) - jnp.log(sigma) - _LOG_SQRT_2PI, NEG_BIG
     )
     # rows: coefficient of z², coefficient of z, constant
     return jnp.stack([-0.5 * inv2, mu * inv2, logcoef - 0.5 * mu * mu * inv2])
@@ -88,7 +93,13 @@ def pair_score(z, params_pair, k_below: int, chunk=4096):
     C = z.shape[0]
 
     def score_block(zb):
-        comp = _features(zb) @ params_pair  # [chunk, Kb+Ka] -> MXU
+        # rank-3 matmul on the MXU; HIGHEST keeps true-f32 accumulation
+        # (default bf16 passes lose ~1e0 absolute at 10k components —
+        # enough to randomize the EI argmax; the op is bandwidth-bound so
+        # the extra passes are ~free)
+        comp = jnp.matmul(
+            _features(zb), params_pair, precision=jax.lax.Precision.HIGHEST
+        )  # [chunk, Kb+Ka]
         return _logsumexp_rows(comp[:, :k_below]) - _logsumexp_rows(
             comp[:, k_below:]
         )
