@@ -33,6 +33,7 @@ import numpy as np
 from ..base import miscs_update_idxs_vals
 from ..ops import gmm as gmm_ops
 from ..ops import parzen as parzen_ops
+from ..ops import score as score_ops
 from ..vectorize import idxs_vals_from_batch
 from . import rand
 
@@ -292,7 +293,7 @@ def _continuous_best_core(
         z = jnp.log(jnp.maximum(cand, EPS)) if log_scale else cand
         params = pair_params(wb, mb, sb, wa, ma, sa)
         k_below = wb.shape[0]
-        if scorer == "pallas":
+        if score_ops.effective_scorer(scorer, params.shape[-1]) == "pallas":
             score = pair_score_pallas(z, params, k_below)
         else:
             score = pair_score(z, params, k_below)
@@ -456,7 +457,7 @@ def _continuous_family_core(
         z = jnp.log(jnp.maximum(cands, EPS)) if log_scale else cands
         params = jax.vmap(pair_params)(*B, *A)  # [L, 3, Kb+Ka]
         k_below = B[0].shape[1]
-        if scorer == "pallas":
+        if score_ops.effective_scorer(scorer, params.shape[-1]) == "pallas":
             score = pair_score_pallas_batched(z, params, k_below)
         else:
             score = jax.vmap(partial(pair_score, k_below=k_below))(z, params)
@@ -604,6 +605,7 @@ def _suggest_device(
                     hard[lb] = np.full(k, float(center), np.float64)
 
     chosen_vals = {}
+    pending = []  # (family, device [L, k] winners) — readback deferred
     for fam in dh.families.values():
         keys = label_keys[fam.kis]
         lock_c = np.zeros(fam.L, np.float32)
@@ -674,7 +676,13 @@ def _suggest_device(
                 n_cand=int(n_EI_candidates),
                 lf=lf,
             )
-        best = np.asarray(best)  # [L, k] — the only readback
+        pending.append((fam, best))
+    # all families dispatched (async) before any readback: per-family
+    # device programs overlap, and the host pays the device round trip
+    # once instead of once per family
+    fetched = jax.device_get([b for _, b in pending])
+    for (fam, _), best in zip(pending, fetched):
+        best = np.asarray(best)  # [L, k]
         for i, lb in enumerate(fam.labels):
             if lb not in hard:
                 chosen_vals[lb] = fam.from_fit_space(i, best[i])

@@ -461,7 +461,9 @@ def _family_suggest_core(
         z = jnp.log(jnp.maximum(cands, EPS)) if log_scale else cands
         params = jax.vmap(pair_params)(*B, *A)  # [L, 3, Kb+Ka]
         k_below = B[0].shape[1]
-        if scorer == "pallas":
+        from ..ops.score import effective_scorer
+
+        if effective_scorer(scorer, params.shape[-1]) == "pallas":
             score = pair_score_pallas_batched(z, params, k_below)
         else:
             score = jax.vmap(partial(pair_score, k_below=k_below))(z, params)

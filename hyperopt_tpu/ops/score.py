@@ -71,6 +71,30 @@ def pair_params(wb, mb, sb, wa, ma, sa):
     )
 
 
+# Below this total component count the XLA scorer's [chunk, K] comp
+# intermediate fits in VMEM and XLA's own tiling beats the hand kernel
+# (measured on v5e: K=4130 xla 95 vs pallas 75 GEI/s; K=8226 xla 51 vs
+# pallas 87 — the flip is the HBM spill of the comp matrix, which the
+# Pallas online logsumexp never materializes).
+PALLAS_MIN_K = 6144
+
+
+def effective_scorer(scorer: str, k_total: int) -> str:
+    """Static scorer choice per mixture size (shapes are trace-time).
+
+    The K-crossover only applies to the *auto-selected* scorer; an
+    explicit HYPEROPT_TPU_SCORER force is honored verbatim (so the
+    Pallas path can be exercised on small histories deliberately).
+    """
+    import os
+
+    if os.environ.get("HYPEROPT_TPU_SCORER"):
+        return scorer
+    if scorer == "pallas" and k_total < PALLAS_MIN_K:
+        return "xla"
+    return scorer
+
+
 def _features(z):
     return jnp.stack([z * z, z, jnp.ones_like(z)], axis=1)  # [C, 3]
 

@@ -83,6 +83,19 @@ def test_scorer_selection_env(monkeypatch):
     assert _use_pallas() in ("xla", "pallas")
 
 
+def test_effective_scorer_crossover(monkeypatch):
+    from hyperopt_tpu.ops.score import PALLAS_MIN_K, effective_scorer
+
+    monkeypatch.delenv("HYPEROPT_TPU_SCORER", raising=False)
+    # auto-selected pallas demotes to xla below the VMEM-spill crossover
+    assert effective_scorer("pallas", PALLAS_MIN_K - 1) == "xla"
+    assert effective_scorer("pallas", PALLAS_MIN_K) == "pallas"
+    assert effective_scorer("xla", 10**6) == "xla"
+    # an explicit force is honored verbatim at any size
+    monkeypatch.setenv("HYPEROPT_TPU_SCORER", "pallas")
+    assert effective_scorer("pallas", 8) == "pallas"
+
+
 def test_pallas_batched_matches_single():
     rng = np.random.default_rng(5)
     L, C, K = 3, 200, 50
