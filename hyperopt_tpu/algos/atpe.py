@@ -92,8 +92,66 @@ class Hyperparameter:
 
 
 # targets the meta-model predicts (reference: gamma, nEICandidates,
-# resultFilteringMode, secondaryCutoff, ...)
-META_TARGETS = ("gamma", "n_EI_candidates", "prior_weight", "secondary_cutoff")
+# resultFilteringMode, secondaryCutoff, ...).  result_filtering_mode is a
+# classifier target; the rest are regressors.  n_EI_candidates is trained
+# and predicted in log2 (see scaling_model.json "transforms").
+META_TARGETS = (
+    "gamma",
+    "n_EI_candidates",
+    "prior_weight",
+    "secondary_cutoff",
+    "result_filtering_mode",
+    "result_filtering_multiplier",
+)
+
+FILTER_MODES = ("none", "age", "loss_rank", "random")
+
+# shipped artifacts (hyperopt_tpu/models/atpe_models/) — the reference
+# ships hyperopt/atpe_models/{scaling_model.json, model-<target>.txt};
+# ours are sklearn pickles trained by hyperopt_tpu.models.train_atpe
+DEFAULT_MODEL_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "models",
+    "atpe_models",
+)
+
+
+def build_trial_filter(mode, multiplier):
+    """The reference's ``resultFilteringMode`` as a ``trial_filter`` mask
+    builder for ``tpe.suggest`` — restricts which completed trials feed
+    the Parzen posterior:
+
+    - ``age``: keep the most recent ``ceil(multiplier · n)`` trials;
+    - ``loss_rank``: keep the best ``ceil(multiplier · n)`` by loss;
+    - ``random``: keep a deterministic (size-seeded) random fraction;
+    - ``none``: no filtering (returns None).
+    """
+    if mode is None or mode == "none":
+        return None
+    mult = float(np.clip(multiplier, 0.2, 1.0))
+
+    def filt(hist):
+        n = len(hist.losses)
+        keep = min(n, max(int(np.ceil(mult * n)), 10))
+        mask = np.zeros(n, dtype=bool)
+        if keep >= n:
+            mask[:] = True
+            return mask
+        if mode == "age":
+            order = np.argsort(hist.loss_tids, kind="stable")  # oldest→newest
+            mask[order[-keep:]] = True
+        elif mode == "loss_rank":
+            order = np.argsort(hist.losses, kind="stable")
+            mask[order[:keep]] = True
+        elif mode == "random":
+            # deterministic for a given history size → reproducible runs
+            ridx = np.random.default_rng(n).permutation(n)[:keep]
+            mask[ridx] = True
+        else:
+            raise ValueError(f"unknown result_filtering_mode {mode!r}")
+        return mask
+
+    return filt
 
 FEATURE_NAMES = (
     "n_parameters",
@@ -132,8 +190,16 @@ class ATPEOptimizer:
         for target in META_TARGETS:
             p = os.path.join(model_dir, f"model-{target}.pkl")
             if os.path.exists(p):
-                with open(p, "rb") as f:
-                    self.models[target] = pickle.load(f)
+                try:
+                    with open(p, "rb") as f:
+                        self.models[target] = pickle.load(f)
+                except Exception as e:
+                    # sklearn absent (optional extra) or version-skewed
+                    # pickle: this target stays on the heuristic rules
+                    logger.warning(
+                        "atpe: could not load %s (%s); using heuristic "
+                        "for %r", p, e, target,
+                    )
         logger.info(
             "atpe: loaded %d meta-models from %s", len(self.models), model_dir
         )
@@ -227,17 +293,30 @@ class ATPEOptimizer:
     def predict_meta(self, feats):
         """Meta-parameters for this suggest step (models else heuristics)."""
         meta = self._heuristic_meta(feats)
+        transforms = (self.scaling or {}).get("transforms", {})
         if self.models:
             x = self._vectorize(feats)
             for target, model in self.models.items():
                 try:
-                    meta[target] = float(model.predict(x)[0])
+                    pred = model.predict(x)[0]
                 except Exception as e:  # corrupt artifact: keep heuristic
                     logger.warning("atpe model %s failed: %s", target, e)
+                    continue
+                if target == "result_filtering_mode":
+                    meta[target] = str(pred)
+                elif transforms.get(target) == "log2":
+                    meta[target] = float(2.0 ** float(pred))
+                else:
+                    meta[target] = float(pred)
         meta["gamma"] = float(np.clip(meta["gamma"], 0.1, 0.5))
         meta["n_EI_candidates"] = int(np.clip(meta["n_EI_candidates"], 8, 4096))
         meta["prior_weight"] = float(np.clip(meta["prior_weight"], 0.25, 2.0))
         meta["secondary_cutoff"] = float(np.clip(meta["secondary_cutoff"], 0.0, 1.0))
+        if meta.get("result_filtering_mode") not in FILTER_MODES:
+            meta["result_filtering_mode"] = "none"
+        meta["result_filtering_multiplier"] = float(
+            np.clip(meta.get("result_filtering_multiplier", 1.0), 0.2, 1.0)
+        )
         return meta
 
     @staticmethod
@@ -259,11 +338,19 @@ class ATPEOptimizer:
         secondary_cutoff = float(
             np.clip(0.05 + 0.01 * feats["n_parameters"], 0.05, 0.3)
         )
+        # long histories: age-filter the posterior (recent trials reflect
+        # the exploited region); short ones keep everything
+        if n > 300:
+            filtering_mode, filtering_mult = "age", 0.5
+        else:
+            filtering_mode, filtering_mult = "none", 1.0
         return {
             "gamma": float(gamma),
             "n_EI_candidates": float(n_ei),
             "prior_weight": prior_weight,
             "secondary_cutoff": secondary_cutoff,
+            "result_filtering_mode": filtering_mode,
+            "result_filtering_multiplier": filtering_mult,
         }
 
     # -- parameter locking (the cascade) ---------------------------------
@@ -296,6 +383,68 @@ class ATPEOptimizer:
         return frozenset(drivers)
 
 
+def locks_from_labels(domain, trials, locked):
+    """Locked labels → ``{label: (center, radius)}`` for
+    ``tpe.suggest(param_locks=...)``.
+
+    Locks are OBSERVATION FILTERS, not value overwrites: each locked
+    label's history is narrowed to the incumbent's neighborhood before
+    the Parzen fits, so the suggestion is still sampled through the real
+    posterior and conditional-branch activity stays consistent by
+    construction (the reference's per-parameter filtering/resampling
+    semantics, ``hyperopt/atpe.py`` ~L300-700, rebuilt as posterior
+    shaping).  Also used by the offline meta-model trainer
+    (``hyperopt_tpu.models.train_atpe``) so training and inference share
+    one lock semantics."""
+    if not locked:
+        return {}
+    try:
+        best_misc = trials.best_trial["misc"]
+    except Exception:
+        return {}
+    hist = trials.history
+    param_locks = {}
+    for lb in locked:
+        best_vals = best_misc["vals"].get(lb)
+        if not best_vals:
+            continue  # label inactive in the incumbent: no lock
+        center = float(best_vals[0])
+        spec = domain.space.specs[lb]
+        if spec.dist in ("randint", "categorical") or spec.is_integer:
+            radius = 0.0  # hard pin to the incumbent category
+        else:
+            obs = np.asarray(hist.vals.get(lb, []), dtype=float)
+            hp_view = Hyperparameter(lb, spec)
+            if hp_view.is_log_scale:
+                # soft-lock radii are log-space for log dists
+                obs = np.log(np.maximum(obs, 1e-12))
+            spread = float(obs.std()) if len(obs) > 1 else 0.0
+            if spread <= 0:
+                continue
+            radius = 0.25 * spread
+        param_locks[lb] = (center, radius)
+    return param_locks
+
+
+_optimizer_cache = {}
+
+
+def _optimizer_for(model_dir):
+    """Per-directory cached optimizer (artifact unpickling is not free
+    and suggest runs every iteration).  ``model_dir=None`` resolves to
+    the shipped artifacts when present, else the heuristic fallback."""
+    if model_dir is None:
+        has_artifacts = os.path.exists(
+            os.path.join(DEFAULT_MODEL_DIR, "scaling_model.json")
+        )
+        model_dir = DEFAULT_MODEL_DIR if has_artifacts else ""
+    opt = _optimizer_cache.get(model_dir)
+    if opt is None:
+        opt = ATPEOptimizer(model_dir=model_dir or None)
+        _optimizer_cache[model_dir] = opt
+    return opt
+
+
 def suggest(
     new_ids,
     domain,
@@ -312,7 +461,7 @@ def suggest(
     if len(trials.trials) < n_startup_jobs or len(hist.losses) == 0:
         return rand.suggest(new_ids, domain, trials, seed)
 
-    optimizer = ATPEOptimizer(model_dir=model_dir)
+    optimizer = _optimizer_for(model_dir)
     feats, per_param_corr = optimizer.compute_features(domain, trials)
     meta = optimizer.predict_meta(feats)
     rng = np.random.default_rng(seed)
@@ -325,42 +474,15 @@ def suggest(
         exclude=ATPEOptimizer.condition_driver_labels(domain),
     )
 
-    # Locks are OBSERVATION FILTERS, not value overwrites: each locked
-    # label's history is narrowed to the incumbent's neighborhood before
-    # the Parzen fits (tpe.suggest(param_locks=...)), so the suggestion is
-    # still sampled through the real posterior and conditional-branch
-    # activity stays consistent by construction (the reference's
-    # per-parameter filtering/resampling semantics, ``hyperopt/atpe.py``
-    # ~L300-700, rebuilt as posterior shaping).
-    param_locks = {}
-    if locked:
-        try:
-            best_misc = trials.best_trial["misc"]
-        except Exception:
-            best_misc = None
-        if best_misc is not None:
-            hist = trials.history
-            for lb in locked:
-                best_vals = best_misc["vals"].get(lb)
-                if not best_vals:
-                    continue  # label inactive in the incumbent: no lock
-                center = float(best_vals[0])
-                spec = domain.space.specs[lb]
-                if spec.dist in ("randint", "categorical") or spec.is_integer:
-                    radius = 0.0  # hard pin to the incumbent category
-                else:
-                    obs = np.asarray(hist.vals.get(lb, []), dtype=float)
-                    hp_view = Hyperparameter(lb, spec)
-                    if hp_view.is_log_scale:
-                        # soft-lock radii are log-space for log dists
-                        obs = np.log(np.maximum(obs, 1e-12))
-                    spread = float(obs.std()) if len(obs) > 1 else 0.0
-                    if spread <= 0:
-                        continue
-                    radius = 0.25 * spread
-                param_locks[lb] = (center, radius)
-        if verbose and param_locks:
-            logger.debug("atpe locked params: %s (meta=%s)", sorted(param_locks), meta)
+    param_locks = locks_from_labels(domain, trials, locked)
+    if verbose and param_locks:
+        logger.debug("atpe locked params: %s (meta=%s)", sorted(param_locks), meta)
+
+    # the resultFilteringMode analog: the meta layer picks which slice of
+    # history feeds the Parzen posterior (age / loss-rank / random)
+    trial_filter = build_trial_filter(
+        meta["result_filtering_mode"], meta["result_filtering_multiplier"]
+    )
 
     return tpe.suggest(
         new_ids,
@@ -372,4 +494,5 @@ def suggest(
         n_EI_candidates=meta["n_EI_candidates"],
         gamma=meta["gamma"],
         param_locks=param_locks or None,
+        trial_filter=trial_filter,
     )

@@ -376,28 +376,30 @@ def _continuous_best_sharded(
     C_pad = ((C + dp - 1) // dp) * dp
     z = jnp.log(jnp.maximum(cand, EPS)) if log_scale else cand
     z = jnp.pad(z, (0, C_pad - C))
-    scorer = _sharded_scorer_for(mesh)
-    # score in the log domain; bounds are log-space for log dists already
-    score = np.asarray(
-        scorer(
-            np.asarray(z, np.float32), wb, mb, sb, wa, ma, sa,
-            np.float32(low), np.float32(high),
-        )
-    )[:C].reshape(k, n_cand)
-    cand = np.asarray(cand).reshape(k, n_cand)
-    return cand[np.arange(k), np.argmax(score, axis=1)]
+    best_fn = _sharded_best_for(mesh)
+    # score in the log domain (bounds are log-space for log dists
+    # already); score + argmax + winner gather all run on the mesh, so
+    # the only readback is the [k] winners (the O(k)-readback rule,
+    # tpe_device.py — previously this path round-tripped the full [C]
+    # score vector through host numpy)
+    best = best_fn(
+        cand, jnp.asarray(z, jnp.float32), wb, mb, sb, wa, ma, sa,
+        np.float32(low), np.float32(high), k=k, n_cand=n_cand,
+    )
+    return np.asarray(best)
 
 
 _sharded_scorers = {}
+_warned_quantized = set()  # labels already warned about mesh fallthrough
 
 
-def _sharded_scorer_for(mesh):
-    from ..parallel.sharding import make_sharded_score
+def _sharded_best_for(mesh):
+    from ..parallel.sharding import make_sharded_best
 
     key = id(mesh)
     fn = _sharded_scorers.get(key)
     if fn is None:
-        fn = make_sharded_score(mesh)
+        fn = make_sharded_best(mesh)
         _sharded_scorers[key] = fn
     return fn
 
@@ -711,6 +713,9 @@ def suggest(
     continuous-label scoring is then sharded across devices (candidates
     over dp, mixture components over sp), e.g.
     ``partial(tpe.suggest, mesh=default_mesh(), n_EI_candidates=65536)``.
+    Quantized dists (``quniform``/``qloguniform``/``uniformint``/...)
+    have no sharded scorer and fall back to the single-device family
+    kernel (a warning is logged once per label).
 
     ``param_locks``: optional ``{label: (center, radius)}`` — the ATPE
     "cascade" (reference ``hyperopt/atpe.py`` ~L300-700) without post-hoc
@@ -844,6 +849,17 @@ def suggest(
                     prior_sigma = min(prior_sigma, 2.0 * radius)
                     b_fit = b_fit[np.abs(b_fit - c_fit) <= radius]
                     a_fit = a_fit[np.abs(a_fit - c_fit) <= radius]
+            if mesh is not None and quantized and label not in _warned_quantized:
+                # quantized dists score through CDF-bucket integration,
+                # which has no sharded formulation yet — the label runs on
+                # the unsharded family kernel and gets no sp scaling
+                _warned_quantized.add(label)
+                logger.warning(
+                    "tpe.suggest(mesh=...): quantized label %r falls back "
+                    "to the single-device family kernel (no sharded "
+                    "quantized scorer); its history axis will not scale "
+                    "across the mesh", label,
+                )
             if mesh is not None and not quantized:
                 pb = parzen_ops.bucket(len(b_fit))
                 pa = parzen_ops.bucket(len(a_fit))

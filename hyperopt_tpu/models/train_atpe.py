@@ -1,0 +1,313 @@
+"""Offline ATPE meta-model training (the reference's atpe_models pipeline).
+
+Reference parity (SURVEY.md §2 #15): the reference ships pretrained
+LightGBM artifacts (``hyperopt/atpe_models/scaling_model.json``,
+``model-<target>.txt``) produced by an offline sweep over benchmark
+optimization problems.  That corpus is unobtainable offline and LightGBM
+is absent, so this trainer regenerates the same artifact *shape* from
+this repo's own domain zoo with sklearn gradient boosting:
+
+1. For each (domain, seed): run a base TPE optimization and snapshot the
+   trials at checkpoints — each snapshot is one "optimization state".
+2. For each state: continue the run under many sampled TPE meta-configs
+   (γ, n_EI_candidates, prior_weight, secondary-cutoff locks,
+   result-filtering mode/multiplier) for a fixed budget and record the
+   final best loss.
+3. Label each state with the meta-config statistics of its top-quartile
+   continuations (majority vote for the filtering mode), featurize the
+   state with ``ATPEOptimizer.compute_features``, and fit one model per
+   ``META_TARGETS`` entry (classifier for the mode, regressors else;
+   n_EI_candidates in log2).
+4. Write ``scaling_model.json`` (feature normalization + transforms +
+   provenance) and ``model-<target>.pkl`` artifacts.
+
+Run:  python -m hyperopt_tpu.models.train_atpe [--quick] [--out DIR]
+(CPU is fine — spaces are tiny; jit caches make the sweep minutes, not
+hours.  --quick shrinks everything for CI smoke.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import os
+import pickle
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+DEFAULT_DOMAINS = (
+    "quadratic1",
+    "gauss_wave2",
+    "branin",
+    "hartmann6",
+    "many_dists",
+    "q1_choice",
+)
+
+GRID = {
+    "gamma": (0.15, 0.25, 0.40),
+    "n_EI_candidates": (24, 256),
+    "prior_weight": (0.5, 1.0),
+    "secondary_cutoff": (0.0, 0.25),
+    "result_filtering": (
+        ("none", 1.0),
+        ("age", 0.5),
+        ("loss_rank", 0.6),
+        ("random", 0.7),
+    ),
+}
+
+
+def sample_configs(n, rng):
+    """n distinct meta-configs sampled uniformly from the grid product."""
+    seen, out = set(), []
+    while len(out) < n:
+        cfg = {
+            "gamma": rng.choice(GRID["gamma"]),
+            "n_EI_candidates": int(rng.choice(GRID["n_EI_candidates"])),
+            "prior_weight": rng.choice(GRID["prior_weight"]),
+            "secondary_cutoff": rng.choice(GRID["secondary_cutoff"]),
+        }
+        mode, mult = GRID["result_filtering"][rng.integers(len(GRID["result_filtering"]))]
+        cfg["result_filtering_mode"] = mode
+        cfg["result_filtering_multiplier"] = mult
+        key = tuple(sorted((k, str(v)) for k, v in cfg.items()))
+        if key in seen:
+            if len(seen) >= 3 * 2 * 2 * 2 * 4:  # grid exhausted
+                break
+            continue
+        seen.add(key)
+        out.append(cfg)
+    return out
+
+
+def _run_base(domain, seed, n_trials):
+    from hyperopt_tpu import Trials, fmin, tpe
+
+    trials = Trials()
+    fmin(
+        domain.fn,
+        domain.space,
+        algo=tpe.suggest,
+        max_evals=n_trials,
+        trials=trials,
+        rstate=np.random.default_rng(seed),
+        show_progressbar=False,
+        verbose=False,
+    )
+    return trials
+
+
+def _continue_with(domain, snapshot_docs, cfg, extra_evals, seed):
+    """Continue a snapshotted run under one meta-config; return final best."""
+    from hyperopt_tpu import Trials, fmin, tpe
+    from hyperopt_tpu.base import Domain, trials_from_docs
+    from ..algos import atpe as atpe_mod
+
+    trials = trials_from_docs(copy.deepcopy(snapshot_docs))
+    dom = Domain(domain.fn, domain.space)
+
+    # secondary-cutoff locks chosen once at the checkpoint (the per-call
+    # re-choice in atpe.suggest averages to the same behavior)
+    param_locks = None
+    if cfg["secondary_cutoff"] > 0:
+        opt = atpe_mod.ATPEOptimizer()
+        _, per_param_corr = opt.compute_features(dom, trials)
+        rng = np.random.default_rng(seed + 10_000)
+        locked = opt.choose_locks(
+            per_param_corr,
+            cfg["secondary_cutoff"],
+            rng,
+            exclude=atpe_mod.ATPEOptimizer.condition_driver_labels(dom),
+        )
+        param_locks = atpe_mod.locks_from_labels(dom, trials, locked) or None
+
+    trial_filter = atpe_mod.build_trial_filter(
+        cfg["result_filtering_mode"], cfg["result_filtering_multiplier"]
+    )
+    algo = partial(
+        tpe.suggest,
+        gamma=cfg["gamma"],
+        n_EI_candidates=cfg["n_EI_candidates"],
+        prior_weight=cfg["prior_weight"],
+        param_locks=param_locks,
+        trial_filter=trial_filter,
+    )
+    n0 = len(trials.trials)
+    fmin(
+        domain.fn,
+        domain.space,
+        algo=algo,
+        max_evals=n0 + extra_evals,
+        trials=trials,
+        rstate=np.random.default_rng(seed + 20_000),
+        show_progressbar=False,
+        verbose=False,
+    )
+    losses = [l for l in trials.losses() if l is not None]
+    return float(np.min(losses)) if losses else float("inf")
+
+
+def build_corpus(domains, seeds, checkpoints, n_configs, cont_evals, log=print):
+    from hyperopt_tpu.base import Domain
+    from . import domains as zoo
+    from ..algos import atpe as atpe_mod
+
+    rng = np.random.default_rng(0)
+    configs = sample_configs(n_configs, rng)
+    rows = []  # (features dict, labels dict)
+    t0 = time.time()
+    for dname in domains:
+        domain = zoo.get(dname)
+        for seed in seeds:
+            base = _run_base(domain, seed, max(checkpoints))
+            docs = base.trials
+            for ckpt in checkpoints:
+                snapshot = [d for d in docs if d["tid"] < ckpt]
+                if len(snapshot) < 10:
+                    continue
+                dom = Domain(domain.fn, domain.space)
+                from hyperopt_tpu.base import trials_from_docs
+
+                snap_trials = trials_from_docs(copy.deepcopy(snapshot))
+                opt = atpe_mod.ATPEOptimizer()
+                feats, _ = opt.compute_features(dom, snap_trials)
+
+                results = []
+                for ci, cfg in enumerate(configs):
+                    best = _continue_with(
+                        domain, snapshot, cfg, cont_evals, seed * 1000 + ci
+                    )
+                    results.append((best, cfg))
+                results.sort(key=lambda r: r[0])
+                top = [cfg for _, cfg in results[: max(2, len(results) // 4)]]
+                labels = {
+                    "gamma": float(np.mean([c["gamma"] for c in top])),
+                    "n_EI_candidates": float(
+                        np.mean([np.log2(c["n_EI_candidates"]) for c in top])
+                    ),
+                    "prior_weight": float(np.mean([c["prior_weight"] for c in top])),
+                    "secondary_cutoff": float(
+                        np.mean([c["secondary_cutoff"] for c in top])
+                    ),
+                    "result_filtering_mode": max(
+                        set(c["result_filtering_mode"] for c in top),
+                        key=[c["result_filtering_mode"] for c in top].count,
+                    ),
+                    "result_filtering_multiplier": float(
+                        np.mean([c["result_filtering_multiplier"] for c in top])
+                    ),
+                }
+                rows.append((feats, labels))
+                log(
+                    f"  state {dname}/s{seed}/n{ckpt}: "
+                    f"{len(results)} configs, best={results[0][0]:.4g}, "
+                    f"labels γ={labels['gamma']:.2f} "
+                    f"mode={labels['result_filtering_mode']} "
+                    f"[{time.time()-t0:.0f}s]"
+                )
+    return rows
+
+
+def fit_models(rows):
+    from sklearn.ensemble import (
+        GradientBoostingClassifier,
+        GradientBoostingRegressor,
+    )
+
+    from ..algos.atpe import FEATURE_NAMES, META_TARGETS
+
+    X = np.array([[f[k] for k in FEATURE_NAMES] for f, _ in rows])
+    mu, sd = X.mean(axis=0), X.std(axis=0)
+    Xn = (X - mu) / np.where(sd > 0, sd, 1.0)
+
+    models = {}
+    for target in META_TARGETS:
+        y = [lab[target] for _, lab in rows]
+        if target == "result_filtering_mode":
+            if len(set(y)) < 2:
+                # degenerate corpus: constant class — skip, heuristic rules
+                continue
+            m = GradientBoostingClassifier(
+                n_estimators=60, max_depth=2, random_state=0
+            )
+        else:
+            m = GradientBoostingRegressor(
+                n_estimators=60, max_depth=2, random_state=0
+            )
+            y = np.asarray(y, dtype=float)
+        m.fit(Xn, y)
+        models[target] = m
+
+    scaling = {
+        "mean": {k: float(m_) for k, m_ in zip(FEATURE_NAMES, mu)},
+        "std": {k: float(s) for k, s in zip(FEATURE_NAMES, sd)},
+        "transforms": {"n_EI_candidates": "log2"},
+        "corpus_rows": len(rows),
+    }
+    return models, scaling
+
+
+def write_artifacts(models, scaling, out_dir):
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "scaling_model.json"), "w") as f:
+        json.dump(scaling, f, indent=1, sort_keys=True)
+    for target, model in models.items():
+        with open(os.path.join(out_dir, f"model-{target}.pkl"), "wb") as f:
+            pickle.dump(model, f)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", default=None, help="artifact directory")
+    ap.add_argument("--quick", action="store_true", help="tiny CI-smoke corpus")
+    ap.add_argument("--domains", nargs="*", default=None)
+    ap.add_argument("--seeds", type=int, default=2)
+    ap.add_argument("--configs", type=int, default=32)
+    ap.add_argument("--cont-evals", type=int, default=15)
+    ap.add_argument(
+        "--tpu", action="store_true",
+        help="allow the TPU backend (default forces CPU: the sweep is "
+        "thousands of tiny-history suggests, where per-call dispatch "
+        "latency dominates any device win)",
+    )
+    args = ap.parse_args(argv)
+
+    if not args.tpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from ..algos.atpe import DEFAULT_MODEL_DIR
+
+    out = args.out or DEFAULT_MODEL_DIR
+    if args.quick:
+        domains = args.domains or ["quadratic1", "gauss_wave2"]
+        seeds, checkpoints = [0], (20,)
+        n_configs, cont = 6, 6
+    else:
+        domains = args.domains or list(DEFAULT_DOMAINS)
+        seeds, checkpoints = list(range(args.seeds)), (20, 45)
+        n_configs, cont = args.configs, args.cont_evals
+
+    print(
+        f"train_atpe: {len(domains)} domains x {len(seeds)} seeds x "
+        f"{len(checkpoints)} checkpoints x {n_configs} configs "
+        f"x {cont} continuation evals -> {out}"
+    )
+    rows = build_corpus(domains, seeds, checkpoints, n_configs, cont)
+    if not rows:
+        print("train_atpe: empty corpus, nothing written", file=sys.stderr)
+        return 1
+    models, scaling = fit_models(rows)
+    write_artifacts(models, scaling, out)
+    print(f"train_atpe: wrote {len(models)} models + scaling to {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -183,14 +183,52 @@ class FileJobs:
 
     @staticmethod
     def _unlock_if_owner(lock, owner):
+        """Atomic rename-then-verify unlock.
+
+        A read-then-unlink unlock has a TOCTOU hole: between our owner
+        check and our unlink, ``requeue_stale`` can unlink the lock and
+        another worker recreate it — our unlink then destroys THEIR
+        reservation.  Instead the lock is renamed aside to a unique name
+        first (rename(2) is atomic: exactly one process possesses the
+        inode afterwards), the owner is verified on the private copy, and
+        a lock that turns out not to be ours is restored with link(2)
+        (create-iff-absent, so a newer lock at the path is never
+        clobbered)."""
+        # read-only gate first: a lock that is visibly not ours is never
+        # touched (same as the pre-fix behavior — no displacement risk)
         try:
             with open(lock) as f:
                 if f.read() != owner:
                     return False
-            os.unlink(lock)
-            return True
         except FileNotFoundError:
             return False
+        # it read as ours: take atomic possession, then RE-verify — this
+        # closes the read→unlink window (requeue_stale can unlink our
+        # lock and another worker recreate it in between; a plain unlink
+        # here would destroy THEIR reservation)
+        tmp = f"{lock}.unlock.{os.getpid()}.{time.monotonic_ns()}"
+        try:
+            os.rename(lock, tmp)
+        except FileNotFoundError:
+            return False
+        with open(tmp) as f:
+            mine = f.read() == owner
+        if mine:
+            os.unlink(tmp)
+            return True
+        # double race: the lock changed hands between read and rename —
+        # restore it with link(2) (create-iff-absent never clobbers a
+        # third party's even-newer lock; in that triple-race case their
+        # claim stands and the displaced one is dropped with a warning)
+        try:
+            os.link(tmp, lock)
+        except FileExistsError:
+            logger.warning(
+                "unlock race on %s: displaced a non-owner lock that could "
+                "not be restored (a newer lock exists)", lock,
+            )
+        os.unlink(tmp)
+        return False
 
     def _try_lock(self, lock, owner):
         r = _native.try_lock(lock, owner)

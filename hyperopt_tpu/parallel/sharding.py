@@ -106,6 +106,28 @@ def make_sharded_score(mesh: Mesh, dp: str = "dp", sp: str = "sp"):
     return jax.jit(score)
 
 
+def make_sharded_best(mesh: Mesh, dp: str = "dp", sp: str = "sp"):
+    """Sharded score → per-id argmax → ``[k]`` winners, all on device.
+
+    Composes :func:`make_sharded_score` with the reshape/argmax/gather so
+    the only host readback per label is the ``[k]`` winning values —
+    the O(k)-readback rule the device path documents
+    (``tpe_device.py``), now held on the mesh path too (the [C] score
+    vector never leaves the device).
+    """
+    score_fn = make_sharded_score(mesh, dp, sp)
+
+    @partial(jax.jit, static_argnames=("k", "n_cand"))
+    def best(cand, z_pad, wb, mb, sb, wa, ma, sa, low, high, *, k, n_cand):
+        s = score_fn(z_pad, wb, mb, sb, wa, ma, sa, low, high)
+        s = s[: k * n_cand].reshape(k, n_cand)
+        c = cand[: k * n_cand].reshape(k, n_cand)
+        idx = jnp.argmax(s, axis=1)
+        return jnp.take_along_axis(c, idx[:, None], axis=1)[:, 0]
+
+    return best
+
+
 def make_sharded_batch_eval(mesh: Mesh, fn, dp: str = "dp"):
     """Vectorized on-device objective evaluation, batch sharded over dp.
 

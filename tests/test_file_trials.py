@@ -259,3 +259,38 @@ def flaky_objective(cfg):
     if cfg["x"] < 0:
         raise RuntimeError("negative")
     return cfg["x"]
+
+
+class TestUnlockAtomicity:
+    """Rename-then-verify unlock (the read->unlink TOCTOU fix)."""
+
+    def test_unlock_own_lock(self, tmp_path):
+        from hyperopt_tpu.parallel.file_trials import FileJobs
+
+        jobs = FileJobs(str(tmp_path / "q"))
+        lock = str(tmp_path / "q" / "locks" / "0.lock")
+        assert jobs._try_lock(lock, "me")
+        assert jobs._unlock_if_owner(lock, "me") is True
+        assert not os.path.exists(lock)
+
+    def test_unlock_preserves_foreign_lock(self, tmp_path):
+        from hyperopt_tpu.parallel.file_trials import FileJobs
+
+        jobs = FileJobs(str(tmp_path / "q"))
+        lock = str(tmp_path / "q" / "locks" / "1.lock")
+        assert jobs._try_lock(lock, "them")
+        assert jobs._unlock_if_owner(lock, "me") is False
+        # their reservation survives, content intact, no stray temp files
+        assert os.path.exists(lock)
+        with open(lock) as f:
+            assert f.read() == "them"
+        leftovers = [p for p in os.listdir(tmp_path / "q" / "locks")
+                     if ".unlock." in p]
+        assert leftovers == []
+
+    def test_unlock_missing_lock(self, tmp_path):
+        from hyperopt_tpu.parallel.file_trials import FileJobs
+
+        jobs = FileJobs(str(tmp_path / "q"))
+        lock = str(tmp_path / "q" / "locks" / "2.lock")
+        assert jobs._unlock_if_owner(lock, "me") is False
