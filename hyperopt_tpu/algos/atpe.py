@@ -158,13 +158,16 @@ FEATURE_NAMES = (
     "frac_categorical",
     "frac_conditional",
     "frac_log_scale",
+    "frac_integer",
     "mean_log2_cardinality",
     "n_trials",
     "log_n_trials",
+    "history_per_param",
     "best_loss",
     "loss_std",
     "loss_iqr",
     "loss_skew",
+    "loss_kurtosis",
     "recent_improvement",
     "frac_failed",
     "top_frac_spread",
@@ -215,6 +218,10 @@ class ATPEOptimizer:
         hps = self.hyperparameters(domain)
         hist = trials.history
         losses = np.asarray(hist.losses, dtype=float)
+        # NaN losses are legitimate diverged trials; they must not poison
+        # the loss statistics (a single NaN would NaN every feature and
+        # silently disable all meta-models' predict())
+        losses = losses[np.isfinite(losses)]
         n = len(losses)
 
         hp_feats = np.array([h.feature_vector() for h in hps.values()])
@@ -230,9 +237,10 @@ class ATPEOptimizer:
                 (float(v), loss_by_tid[int(t)])
                 for t, v in zip(tids, vals)
                 if int(t) in loss_by_tid
+                and np.isfinite(loss_by_tid[int(t)])
             ]
             if len(pts) < 5:
-                corrs.append(0.0)
+                corrs.append(np.nan)  # sentinel: no evidence (≠ corr 0)
                 continue
             v, l = np.array(pts).T
             vr = np.argsort(np.argsort(v)).astype(float)
@@ -241,6 +249,10 @@ class ATPEOptimizer:
             c = 0.0 if not denom else float(np.corrcoef(vr, lr)[0, 1])
             corrs.append(abs(c) if np.isfinite(c) else 0.0)
         corrs = np.asarray(corrs) if corrs else np.zeros(1)
+        # feature aggregates over MEASURED params only (NaN = no evidence)
+        measured = corrs[np.isfinite(corrs)]
+        if measured.size == 0:
+            measured = np.zeros(1)
 
         if n:
             srt = np.sort(losses)
@@ -251,33 +263,55 @@ class ATPEOptimizer:
             mean = losses.mean()
             std = losses.std() or 1.0
             skew = float((mean - med) / std)
+            zs = (losses - mean) / std
+            kurt = float(np.mean(zs**4) - 3.0) if n >= 4 else 0.0
             half = n // 2 or 1
             recent = float(
                 np.min(losses[:half]) - np.min(losses[half:]) if n >= 4 else 0.0
             )
         else:
             top_spread, q25, q75, skew, recent = 0.0, 0.0, 0.0, 0.0, 0.0
+            kurt = 0.0
 
         n_total = len(trials.trials) or 1
+        frac_integer = (
+            float(
+                np.mean(
+                    [
+                        1.0
+                        if (h.spec.is_integer or h.spec.params.get("q"))
+                        else 0.0
+                        for h in hps.values()
+                    ]
+                )
+            )
+            if n_params
+            else 0.0
+        )
         feats = {
             "n_parameters": float(n_params),
             "frac_categorical": float(hp_feats[:, 0].mean()) if n_params else 0.0,
             "frac_conditional": float(hp_feats[:, 2].mean()) if n_params else 0.0,
             "frac_log_scale": float(hp_feats[:, 1].mean()) if n_params else 0.0,
+            "frac_integer": frac_integer,
             "mean_log2_cardinality": float(hp_feats[:, 3].mean()) if n_params else 0.0,
             "n_trials": float(n),
             "log_n_trials": float(np.log1p(n)),
+            "history_per_param": float(n / max(n_params, 1)),
             "best_loss": float(losses.min()) if n else 0.0,
             "loss_std": float(losses.std()) if n else 0.0,
             "loss_iqr": float(q75 - q25),
             "loss_skew": skew,
+            "loss_kurtosis": kurt,
             "recent_improvement": recent,
             "frac_failed": float(1.0 - n / n_total),
             "top_frac_spread": top_spread,
-            "mean_abs_param_loss_corr": float(corrs.mean()),
-            "max_abs_param_loss_corr": float(corrs.max()),
-            "min_abs_param_loss_corr": float(corrs.min()),
+            "mean_abs_param_loss_corr": float(measured.mean()),
+            "max_abs_param_loss_corr": float(measured.max()),
+            "min_abs_param_loss_corr": float(measured.min()),
         }
+        # NaN entries mean "too few observations to measure" — consumers
+        # (choose_locks) must treat them as no-evidence, never as corr 0
         per_param_corr = dict(zip(hps.keys(), corrs)) if n_params else {}
         return feats, per_param_corr
 
@@ -356,9 +390,13 @@ class ATPEOptimizer:
     # -- parameter locking (the cascade) ---------------------------------
     @staticmethod
     def choose_locks(per_param_corr, cutoff, rng, exclude=frozenset()):
-        """Lock params whose loss-rank correlation is below ``cutoff`` with
-        probability 1/2 each (keeps exploration alive, like the
-        reference's filtered-parameter resampling).
+        """Lock params whose loss-rank correlation is below ``cutoff``,
+        with probability proportional to how far below: a parameter with
+        zero measured influence locks with p≈0.75, one just under the
+        cutoff almost never does.  Randomness (vs locking all of them)
+        keeps exploration alive, like the reference's filtered-parameter
+        resampling; the influence-proportional p replaces round-2's
+        uniform coin flip so the cascade actually grades by evidence.
 
         ``exclude``: labels that must never be locked — in particular
         labels that drive conditional branches (a lock there would have to
@@ -367,7 +405,14 @@ class ATPEOptimizer:
         for lb, corr in per_param_corr.items():
             if lb in exclude:
                 continue
-            if corr < cutoff and rng.uniform() < 0.5:
+            # NaN = unmeasured (too few observations): never lock on no
+            # evidence — those are exactly the params that need more data
+            if not np.isfinite(corr):
+                continue
+            if cutoff <= 0 or corr >= cutoff:
+                continue
+            p_lock = 0.75 * (1.0 - corr / cutoff)
+            if rng.uniform() < p_lock:
                 locked.append(lb)
         return locked
 

@@ -193,6 +193,28 @@ from .tpe_device import prior_for as _prior_for  # noqa: E402
 # ---------------------------------------------------------------------
 
 
+def _host_label_keys(seed: int, n: int):
+    """PRNGKey(seed) split n ways, computed on the CPU backend.
+
+    threefry is deterministic across backends, so the values are
+    bit-identical to a device split — but running it on the accelerator
+    costs a dispatch + a blocking readback per suggest (a full network
+    round trip when the chip is tunneled) for 8·n bytes of key material.
+    """
+    import jax
+
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        cpu = None
+    if cpu is not None:
+        with jax.default_device(cpu):
+            return np.asarray(
+                jax.random.split(jax.random.PRNGKey(seed), n)
+            )
+    return np.asarray(jax.random.split(jax.random.PRNGKey(seed), n))
+
+
 _probed_scorer = None
 
 
@@ -590,8 +612,7 @@ def _suggest_device(
     cap_b = parzen_ops.bucket(max(n_below, 1))
     keep_mask = dh.keep_mask(mask)
 
-    key = jax.random.PRNGKey(int(seed))
-    label_keys = np.asarray(jax.random.split(key, dh.n_labels))
+    label_keys = _host_label_keys(int(seed), dh.n_labels)
     scorer = _use_pallas()
     specs = domain.space.specs
 
@@ -607,7 +628,7 @@ def _suggest_device(
                     hard[lb] = np.full(k, float(center), np.float64)
 
     chosen_vals = {}
-    pending = []  # (family, device [L, k] winners) — readback deferred
+    requests, req_fams = [], []  # all families -> ONE device program
     for fam in dh.families.values():
         keys = label_keys[fam.kis]
         lock_c = np.zeros(fam.L, np.float32)
@@ -633,26 +654,19 @@ def _suggest_device(
                         priors[i, 1] = min(float(priors[i, 1]), 2.0 * radius)
                         priors[i, 2], priors[i, 3] = lo, hi
                         lock_c[i], lock_r[i] = c_fit, radius
-            best = td.family_suggest(
-                keys,
-                fam.obs,
-                fam.pos,
-                fam.counts,
-                dh.losses,
-                keep_mask,
-                np.int32(n_below),
-                np.float32(prior_weight),
-                priors,
-                lock_c,
-                lock_r,
-                cap_b=cap_b,
-                k=k,
-                n_cand=int(n_EI_candidates),
-                lf=lf,
-                log_scale=fam.log_scale,
-                quantized=fam.quantized,
-                scorer=scorer,
-            )
+            requests.append((
+                "cont",
+                (
+                    keys, fam.obs, fam.pos, fam.counts, dh.losses,
+                    keep_mask, np.int32(n_below), np.float32(prior_weight),
+                    priors, lock_c, lock_r,
+                ),
+                dict(
+                    cap_b=cap_b, k=k, n_cand=int(n_EI_candidates), lf=lf,
+                    log_scale=fam.log_scale, quantized=fam.quantized,
+                    scorer=scorer,
+                ),
+            ))
         else:
             if param_locks:
                 for i, lb in enumerate(fam.labels):
@@ -660,30 +674,25 @@ def _suggest_device(
                     if lock is not None and lock[1] > 0:
                         lock_c[i] = float(lock[0] - fam.offsets[i])
                         lock_r[i] = float(lock[1])
-            best = td.index_family_suggest(
-                keys,
-                fam.obs,
-                fam.pos,
-                fam.counts,
-                dh.losses,
-                keep_mask,
-                np.int32(n_below),
-                np.float32(prior_weight),
-                fam.prior_p,
-                lock_c,
-                lock_r,
-                cap_b=cap_b,
-                upper=fam.upper,
-                k=k,
-                n_cand=int(n_EI_candidates),
-                lf=lf,
-            )
-        pending.append((fam, best))
-    # all families dispatched (async) before any readback: per-family
-    # device programs overlap, and the host pays the device round trip
-    # once instead of once per family
-    fetched = jax.device_get([b for _, b in pending])
-    for (fam, _), best in zip(pending, fetched):
+            requests.append((
+                "idx",
+                (
+                    keys, fam.obs, fam.pos, fam.counts, dh.losses,
+                    keep_mask, np.int32(n_below), np.float32(prior_weight),
+                    fam.prior_p, lock_c, lock_r,
+                ),
+                dict(
+                    cap_b=cap_b, upper=fam.upper, k=k,
+                    n_cand=int(n_EI_candidates), lf=lf,
+                ),
+            ))
+        req_fams.append(fam)
+    # every family fits/samples/scores in ONE jitted program with ONE
+    # flat readback: per-dispatch latency (a network round trip when the
+    # chip is tunneled) is paid once per suggest, not once per family,
+    # and XLA CSE's the shared loss-ranks argsort across families
+    fetched = td.multi_family_suggest(requests)
+    for fam, best in zip(req_fams, fetched):
         best = np.asarray(best)  # [L, k]
         for i, lb in enumerate(fam.labels):
             if lb not in hard:
@@ -794,8 +803,7 @@ def suggest(
     below_arr = np.fromiter(below_tids, dtype=np.int64, count=len(below_tids))
 
     specs = domain.space.specs
-    key = jax.random.PRNGKey(int(seed))
-    label_keys = jax.random.split(key, len(specs))
+    label_keys = _host_label_keys(int(seed), len(specs))
 
     chosen_vals = {}
     family_items = {}

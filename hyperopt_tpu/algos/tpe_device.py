@@ -18,12 +18,13 @@ This module closes that gap:
   trials uploads O(k) scalars, never the history.  Capacities grow in
   power-of-two buckets, so full re-uploads happen O(log N) times over a
   run's life.
-- :func:`family_suggest` / :func:`index_family_suggest` are ONE jitted
-  program per family per suggest: γ-split (loss ranks), below/above
-  packing, adaptive-Parzen fits, truncated-GMM candidate draw,
-  O(candidates × components) scoring, and per-id argmax all execute on
-  device; the only things crossing the host boundary per suggest are the
-  ``[L]`` prior scalars and the winning ``[L, k]`` values.
+- :func:`multi_family_suggest` runs ALL distribution families of one
+  suggest as ONE jitted program: γ-split (loss ranks, CSE'd across
+  families), below/above packing, adaptive-Parzen fits, truncated-GMM
+  candidate draw, O(candidates × components) scoring, and per-id argmax
+  all execute on device; the only things crossing the host boundary per
+  suggest are the ``[L]`` prior scalars and one flat array of winning
+  values.
 
 The γ-split semantics match ``tpe.ap_split_trials`` exactly: ranks come
 from a stable argsort of the (float32) loss vector, the below set is the
@@ -260,13 +261,13 @@ class DeviceHistory:
         idx[: n - old_n] = np.arange(old_n, n)
         lvals[: n - old_n] = hist.losses[old_n:]
         self.bytes_uploaded += idx.nbytes + lvals.nbytes
-        self.losses = _apply_loss_delta(self.losses, idx, lvals)
         for i, t in enumerate(hist.loss_tids[old_n:]):
             self._tid_row[int(t)] = old_n + i
         self._loss_tids = np.array(hist.loss_tids, np.int64)
         self._losses_synced = np.array(hist.losses, np.float64)
         self._n_synced = n
 
+        changed, fam_deltas = [], []
         for fam in self.families.values():
             rows, cols, vals, poss = [], [], [], []
             for i, label in enumerate(fam.labels):
@@ -292,11 +293,17 @@ class DeviceHistory:
                 c[: len(rows)] = cols
                 v[: len(rows)] = vals
                 p[: len(rows)] = poss
-                self.bytes_uploaded += r.nbytes + c.nbytes + v.nbytes + p.nbytes
-                fam.obs, fam.pos = _apply_family_delta(
-                    fam.obs, fam.pos, r, c, v, p
+                counts = np.asarray(fam.counts_host, np.int32)
+                self.bytes_uploaded += (
+                    r.nbytes + c.nbytes + v.nbytes + p.nbytes + counts.nbytes
                 )
-                fam.counts = self._upload(np.asarray(fam.counts_host, np.int32))
+                changed.append(fam)
+                fam_deltas.append((r, c, v, p, counts))
+        # one dispatch for the whole append (loss + all changed families)
+        state = (self.losses, [(f.obs, f.pos) for f in changed])
+        self.losses, fam_out = _apply_all_deltas(state, idx, lvals, fam_deltas)
+        for fam, (obs, pos, counts) in zip(changed, fam_out):
+            fam.obs, fam.pos, fam.counts = obs, pos, counts
 
 
 def _delta_bucket(n: int) -> int:
@@ -305,19 +312,26 @@ def _delta_bucket(n: int) -> int:
     return max(4, 1 << (max(n, 1) - 1).bit_length())
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
-def _apply_family_delta(obs, pos, rows, cols, vals, poss):
-    """Fused append: padded delta entries carry ``rows == L`` (one past the
-    end) and are dropped by the out-of-bounds scatter mode.  Buffers are
-    donated — on TPU the update is in place, no [L, CAP] copy."""
-    obs = obs.at[rows, cols].set(vals, mode="drop")
-    pos = pos.at[rows, cols].set(poss, mode="drop")
-    return obs, pos
-
-
 @partial(jax.jit, donate_argnums=(0,))
-def _apply_loss_delta(losses, idx, vals):
-    return losses.at[idx].set(vals, mode="drop")
+def _apply_all_deltas(state, loss_idx, loss_vals, fam_deltas):
+    """ONE device program for a whole history append: the loss scatter
+    plus every changed family's (obs, pos) scatter and counts refresh.
+
+    The per-suggest steady state previously dispatched one program per
+    delta (loss + each family + each counts upload) — harmless on a
+    local host, but each dispatch is a round trip when the device sits
+    behind a network tunnel.  ``state`` is ``(losses, [(obs, pos), ...])``
+    for the CHANGED families only (so donation never aliases an
+    untouched buffer); deltas are bucket-padded so the program is reused
+    across calls."""
+    losses, fam_states = state
+    losses = losses.at[loss_idx].set(loss_vals, mode="drop")
+    out = []
+    for (obs, pos), (r, c, v, p, counts) in zip(fam_states, fam_deltas):
+        obs = obs.at[r, c].set(v, mode="drop")
+        pos = pos.at[r, c].set(p, mode="drop")
+        out.append((obs, pos, counts))
+    return losses, out
 
 
 _cache = weakref.WeakKeyDictionary()
@@ -523,23 +537,47 @@ def _index_family_suggest_core(
 _jit_cache = {}
 
 
-def family_suggest(*args, **statics):
+def multi_family_suggest(requests):
+    """ALL families of one suggest as ONE jitted device program.
+
+    ``requests``: list of ``(kind, args, statics)`` with kind "cont" or
+    "idx".  Returns the per-family winner arrays in order.  One dispatch
+    and ONE flat [Σ L·k] f32 output (split host-side) instead of one
+    program + one readback per family — per-dispatch/-transfer cost is a
+    network round trip when the chip sits behind a tunnel — and XLA
+    CSE's the loss-rank argsort the family cores share.  (Index winners
+    ride the f32 concat exactly: category indices are tiny integers,
+    far inside f32's 2^24 exact-integer range.)"""
     import jax
+    import jax.numpy as jnp
+    import numpy as np
 
-    sig = ("cont",) + tuple(sorted(statics.items()))
-    fn = _jit_cache.get(sig)
+    sig = tuple(
+        (kind, tuple(sorted(st.items()))) for kind, _, st in requests
+    )
+    fn = _jit_cache.get(("multi",) + sig)
     if fn is None:
-        fn = jax.jit(partial(_family_suggest_core, **statics))
-        _jit_cache[sig] = fn
-    return fn(*args)
+        cores = [
+            partial(
+                _family_suggest_core if kind == "cont"
+                else _index_family_suggest_core,
+                **st,
+            )
+            for kind, _, st in requests
+        ]
 
+        def run(args_list):
+            outs = [core(*a) for core, a in zip(cores, args_list)]
+            return jnp.concatenate(
+                [o.astype(jnp.float32).reshape(-1) for o in outs]
+            )
 
-def index_family_suggest(*args, **statics):
-    import jax
-
-    sig = ("idx",) + tuple(sorted(statics.items()))
-    fn = _jit_cache.get(sig)
-    if fn is None:
-        fn = jax.jit(partial(_index_family_suggest_core, **statics))
-        _jit_cache[sig] = fn
-    return fn(*args)
+        fn = jax.jit(run)
+        _jit_cache[("multi",) + sig] = fn
+    flat = np.asarray(fn([args for _, args, _ in requests]))
+    outs, off = [], 0
+    for kind, args, st in requests:
+        L, k = args[0].shape[0], st["k"]
+        outs.append(flat[off : off + L * k].reshape(L, k))
+        off += L * k
+    return outs

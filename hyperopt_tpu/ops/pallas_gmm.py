@@ -139,7 +139,7 @@ def _features(z, c_pad: int):
 
 @partial(jax.jit, static_argnames=("k_below", "tc", "tk", "interpret"))
 def pair_score_pallas(
-    z, params_pair, k_below: int, tc: int = 256, tk: int = 512, interpret=False
+    z, params_pair, k_below: int, tc: int = 1024, tk: int = 512, interpret=False
 ):
     """``log l − log g`` for candidates ``z`` ([C]); same contract as
     ``ops.score.pair_score``."""
@@ -167,7 +167,7 @@ def pair_score_pallas(
 
 @partial(jax.jit, static_argnames=("k_below", "tc", "tk", "interpret"))
 def pair_score_pallas_batched(
-    z, params_pair, k_below: int, tc: int = 256, tk: int = 512, interpret=False
+    z, params_pair, k_below: int, tc: int = 1024, tk: int = 512, interpret=False
 ):
     """Label-stacked variant: ``z`` [L, C], ``params_pair`` [L, 3, Kb+Ka]
     → scores [L, C].  Grid is (labels × candidate tiles)."""

@@ -310,3 +310,31 @@ class TestShippedArtifacts:
         docs = atpe.suggest([100], domain, trials, seed=2)
         assert docs[0]["misc"]["vals"]
         assert atpe._optimizer_for(None).models  # artifacts in play
+
+class TestNaNLossRobustness:
+    def test_features_finite_with_diverged_trials(self):
+        """A NaN loss (legitimate diverged trial) must not poison the
+        features and silently disable every meta-model's predict()."""
+        d = domains.get("quadratic1")
+        trials = seeded_trials(d, n=30)
+        # inject a diverged trial
+        doc = trials.trials[5]
+        doc["result"]["loss"] = float("nan")
+        trials.refresh()
+        domain = Domain(d.fn, d.space)
+        opt = atpe._optimizer_for(None)
+        feats, corr = opt.compute_features(domain, trials)
+        assert all(np.isfinite(v) for v in feats.values()), feats
+        meta = opt.predict_meta(feats)
+        assert 0.1 <= meta["gamma"] <= 0.5
+        assert meta["result_filtering_mode"] in atpe.FILTER_MODES
+
+    def test_unmeasured_params_never_locked(self):
+        rng = np.random.default_rng(0)
+        corr = {"unmeasured": float("nan"), "weak": 0.01}
+        hits = 0
+        for _ in range(50):
+            locked = ATPEOptimizer.choose_locks(corr, cutoff=0.2, rng=rng)
+            assert "unmeasured" not in locked
+            hits += "weak" in locked
+        assert hits > 10  # measured-weak still locks with high probability

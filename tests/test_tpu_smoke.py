@@ -107,7 +107,7 @@ def test_scorer_probe_selects_pallas_on_tpu(monkeypatch):
 
 
 def test_tpe_fmin_end_to_end_on_tpu():
-    # full driver loop: DeviceHistory sync + family_suggest on hardware
+    # full driver loop: DeviceHistory sync + multi_family_suggest on hardware
     from hyperopt_tpu import Trials, fmin, hp, tpe
 
     space = {
