@@ -366,11 +366,13 @@ def _continuous_best_sharded(
     n_cand: int,
     lf: int,
     log_scale: bool,
+    quantized: bool = False,
+    q=0.0,
 ):
     """Mesh-sharded variant of the continuous kernel: candidates over
-    ``dp``, mixture components over ``sp`` (blockwise log-sum-exp with
-    psum/pmax over ICI) — the full-history scaling path
-    (``hyperopt_tpu.parallel.sharding``)."""
+    ``dp``, mixture components over ``sp`` (blockwise log-sum-exp — or,
+    for quantized dists, psum'd CDF-bucket integrals — over ICI) — the
+    full-history scaling path (``hyperopt_tpu.parallel.sharding``)."""
     import jax.numpy as jnp
 
     from ..parallel.sharding import pad_mixture
@@ -382,7 +384,8 @@ def _continuous_best_sharded(
         above, n_above, prior_weight, prior_mu, prior_sigma, lf
     )
     cand = gmm_ops.gmm_sample(
-        key, wb, mb, sb, low, high, np.float32(0.0), k * n_cand, log_scale
+        key, wb, mb, sb, low, high,
+        np.float32(q if quantized else 0.0), k * n_cand, log_scale,
     )
     sp = int(mesh.shape["sp"])
     dp = int(mesh.shape["dp"])
@@ -396,32 +399,50 @@ def _continuous_best_sharded(
     wa, ma, sa = _pad_to_sp(wa, ma, sa)
     C = k * n_cand
     C_pad = ((C + dp - 1) // dp) * dp
-    z = jnp.log(jnp.maximum(cand, EPS)) if log_scale else cand
-    z = jnp.pad(z, (0, C_pad - C))
-    best_fn = _sharded_best_for(mesh)
-    # score in the log domain (bounds are log-space for log dists
-    # already); score + argmax + winner gather all run on the mesh, so
-    # the only readback is the [k] winners (the O(k)-readback rule,
-    # tpe_device.py — previously this path round-tripped the full [C]
-    # score vector through host numpy)
-    best = best_fn(
-        cand, jnp.asarray(z, jnp.float32), wb, mb, sb, wa, ma, sa,
-        np.float32(low), np.float32(high), k=k, n_cand=n_cand,
-    )
+    # score + argmax + winner gather all run on the mesh, so the only
+    # readback is the [k] winners (the O(k)-readback rule, tpe_device.py
+    # — previously this path round-tripped the full [C] score vector
+    # through host numpy)
+    if quantized:
+        # bucket-integral scorer takes RAW candidate values
+        x = jnp.pad(cand, (0, C_pad - C))
+        best_fn = _sharded_best_for(mesh, "quant", log_scale)
+        best = best_fn(
+            cand, jnp.asarray(x, jnp.float32), wb, mb, sb, wa, ma, sa,
+            np.float32(low), np.float32(high), np.float32(q),
+            k=k, n_cand=n_cand,
+        )
+    else:
+        # score in the log domain (bounds are log-space for log dists)
+        z = jnp.log(jnp.maximum(cand, EPS)) if log_scale else cand
+        z = jnp.pad(z, (0, C_pad - C))
+        best_fn = _sharded_best_for(mesh, "cont", log_scale)
+        best = best_fn(
+            cand, jnp.asarray(z, jnp.float32), wb, mb, sb, wa, ma, sa,
+            np.float32(low), np.float32(high), k=k, n_cand=n_cand,
+        )
     return np.asarray(best)
 
 
 _sharded_scorers = {}
-_warned_quantized = set()  # labels already warned about mesh fallthrough
 
 
-def _sharded_best_for(mesh):
-    from ..parallel.sharding import make_sharded_best
+def _sharded_best_for(mesh, kind="cont", log_scale=False):
+    from ..parallel.sharding import make_sharded_best, make_sharded_best_quantized
 
-    key = id(mesh)
+    # the continuous scorer works in fit (log) space and doesn't depend
+    # on log_scale — don't let it fragment the cache into two compiles
+    key = (
+        (id(mesh), "quant", bool(log_scale))
+        if kind == "quant"
+        else (id(mesh), "cont")
+    )
     fn = _sharded_scorers.get(key)
     if fn is None:
-        fn = make_sharded_best(mesh)
+        if kind == "quant":
+            fn = make_sharded_best_quantized(mesh, bool(log_scale))
+        else:
+            fn = make_sharded_best(mesh)
         _sharded_scorers[key] = fn
     return fn
 
@@ -722,9 +743,10 @@ def suggest(
     continuous-label scoring is then sharded across devices (candidates
     over dp, mixture components over sp), e.g.
     ``partial(tpe.suggest, mesh=default_mesh(), n_EI_candidates=65536)``.
-    Quantized dists (``quniform``/``qloguniform``/``uniformint``/...)
-    have no sharded scorer and fall back to the single-device family
-    kernel (a warning is logged once per label).
+    Quantized dists shard through the CDF-bucket scorer (plain psum
+    reductions); index dists (randint/categorical) stay on the
+    single-device family kernel — their component axis is the category
+    count, which does not grow with history.
 
     ``param_locks``: optional ``{label: (center, radius)}`` — the ATPE
     "cascade" (reference ``hyperopt/atpe.py`` ~L300-700) without post-hoc
@@ -857,18 +879,7 @@ def suggest(
                     prior_sigma = min(prior_sigma, 2.0 * radius)
                     b_fit = b_fit[np.abs(b_fit - c_fit) <= radius]
                     a_fit = a_fit[np.abs(a_fit - c_fit) <= radius]
-            if mesh is not None and quantized and label not in _warned_quantized:
-                # quantized dists score through CDF-bucket integration,
-                # which has no sharded formulation yet — the label runs on
-                # the unsharded family kernel and gets no sp scaling
-                _warned_quantized.add(label)
-                logger.warning(
-                    "tpe.suggest(mesh=...): quantized label %r falls back "
-                    "to the single-device family kernel (no sharded "
-                    "quantized scorer); its history axis will not scale "
-                    "across the mesh", label,
-                )
-            if mesh is not None and not quantized:
+            if mesh is not None:
                 pb = parzen_ops.bucket(len(b_fit))
                 pa = parzen_ops.bucket(len(a_fit))
                 b_buf, nb = _pad(b_fit, pb)
@@ -889,8 +900,12 @@ def suggest(
                     n_cand=int(n_EI_candidates),
                     lf=lf,
                     log_scale=log_scale,
+                    quantized=quantized,
+                    q=float(q),
                 )
                 best = np.asarray(best, dtype=np.float64)
+                if quantized and specs[label].is_integer:
+                    best = best.astype(np.int64)
                 chosen_vals[label] = best
                 continue
             # accumulate for the label-stacked family kernel below

@@ -105,6 +105,7 @@ class FMinIter:
         show_progressbar=True,
         early_stop_fn=None,
         trials_save_file="",
+        orbax_ckpt=None,
     ):
         self.algo = algo
         self.domain = domain
@@ -129,6 +130,13 @@ class FMinIter:
         self.early_stop_fn = early_stop_fn
         self.early_stop_args = []
         self.trials_save_file = trials_save_file
+        self._orbax_ckpt = orbax_ckpt
+        if orbax_ckpt is None and trials_save_file != "":
+            from .checkpoint import TrialsCheckpointer, is_orbax_path
+
+            if is_orbax_path(trials_save_file):
+                # direct FMinIter construction (no fmin() wrapper)
+                self._orbax_ckpt = TrialsCheckpointer(trials_save_file)
         from .observability import PhaseTimings
 
         self.timings = PhaseTimings()
@@ -266,8 +274,13 @@ class FMinIter:
 
                 self.trials.refresh()
                 if self.trials_save_file != "":
-                    with open(self.trials_save_file, "wb") as f:
-                        pickle.dump(self.trials, f, protocol=self.pickle_protocol)
+                    if self._orbax_ckpt is not None:
+                        self._orbax_ckpt.save(self.trials)
+                    else:
+                        with open(self.trials_save_file, "wb") as f:
+                            pickle.dump(
+                                self.trials, f, protocol=self.pickle_protocol
+                            )
                 if self.early_stop_fn is not None:
                     stop, kwargs = self.early_stop_fn(
                         self.trials, *self.early_stop_args
@@ -376,12 +389,30 @@ def fmin(
     if max_evals is None:
         max_evals = sys.maxsize
 
-    if trials_save_file != "" and os.path.exists(trials_save_file):
-        with open(trials_save_file, "rb") as f:
-            trials = pickle.load(f)
+    orbax_ckpt = None
+    if trials_save_file != "":
+        from .checkpoint import TrialsCheckpointer, is_orbax_path
+
+        if is_orbax_path(trials_save_file):
+            # structured orbax checkpoint (versioned/atomic/retained):
+            # resume from the latest step if the directory has one.  One
+            # manager serves restore AND the run's saves (FMinIter), and
+            # is closed when the run ends — orbax managers hold
+            # background threads.  Restoring ``into`` a user-passed
+            # trials object preserves its subclass and attachments.
+            orbax_ckpt = TrialsCheckpointer(trials_save_file)
+            restored = orbax_ckpt.restore(into=trials)
+            if restored is not None:
+                trials = restored
+        elif os.path.exists(trials_save_file):
+            with open(trials_save_file, "rb") as f:
+                trials = pickle.load(f)
 
     if allow_trials_fmin and trials is not None and hasattr(trials, "fmin"):
         assert not isinstance(trials, list)
+        if orbax_ckpt is not None:
+            # the re-entered fmin opens its own manager on this directory
+            orbax_ckpt.close()
         return trials.fmin(
             fn,
             space,
@@ -431,9 +462,14 @@ def fmin(
         show_progressbar=show_progressbar,
         early_stop_fn=early_stop_fn,
         trials_save_file=trials_save_file,
+        orbax_ckpt=orbax_ckpt,
     )
     rval.catch_eval_exceptions = catch_eval_exceptions
-    rval.exhaust()
+    try:
+        rval.exhaust()
+    finally:
+        if orbax_ckpt is not None:
+            orbax_ckpt.close()
 
     if return_argmin:
         if len(trials.trials) == 0:

@@ -106,6 +106,65 @@ def make_sharded_score(mesh: Mesh, dp: str = "dp", sp: str = "sp"):
     return jax.jit(score)
 
 
+def make_sharded_quantized_score(
+    mesh: Mesh, log_scale: bool, dp: str = "dp", sp: str = "sp"
+):
+    """Sharded quantized pair scorer: ``log l − log g`` where each term
+    integrates the candidate's bucket ``[x − q/2, x + q/2]`` against the
+    mixture via CDF differences (``ops.gmm.gmm_lpdf`` quantized
+    semantics).  Both the bucket mass and ``p_accept`` are plain sums
+    over components, so sharding the component axis is a local partial
+    sum + ``psum`` over ICI — no logsumexp machinery needed."""
+
+    # one source of truth for the bucket/CDF math: ops.gmm's helpers
+    # (this scorer's contract is exact parity with gmm_lpdf quantized)
+    from ..ops.gmm import _cdf, _log_cdf_arg
+
+    def _qprob_block(x, w, mu, sigma, low, high, q):
+        qq = jnp.maximum(q, EPS)
+        if log_scale:
+            raw_low = jnp.where(jnp.isfinite(low), jnp.exp(low), 0.0)
+            raw_high = jnp.where(jnp.isfinite(high), jnp.exp(high), jnp.inf)
+            ub_z = _log_cdf_arg(jnp.minimum(x + qq / 2.0, raw_high))
+            lb_z = _log_cdf_arg(
+                jnp.maximum(jnp.maximum(x - qq / 2.0, raw_low), 0.0)
+            )
+        else:
+            ub_z = jnp.minimum(x + qq / 2.0, high)
+            lb_z = jnp.maximum(x - qq / 2.0, low)
+        prob_loc = jnp.sum(
+            w[None, :]
+            * (
+                _cdf(ub_z[:, None], mu[None, :], sigma[None, :])
+                - _cdf(lb_z[:, None], mu[None, :], sigma[None, :])
+            ),
+            axis=1,
+        )
+        prob = jax.lax.psum(prob_loc, sp)
+        pacc = jax.lax.psum(
+            jnp.sum(w * (_cdf(high, mu, sigma) - _cdf(low, mu, sigma))), sp
+        )
+        return jnp.log(jnp.maximum(prob, EPS)) - jnp.log(jnp.maximum(pacc, EPS))
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(dp),
+            P(sp), P(sp), P(sp),
+            P(sp), P(sp), P(sp),
+            P(), P(), P(),
+        ),
+        out_specs=P(dp),
+    )
+    def score(cand, wb, mb, sb, wa, ma, sa, low, high, q):
+        return _qprob_block(cand, wb, mb, sb, low, high, q) - _qprob_block(
+            cand, wa, ma, sa, low, high, q
+        )
+
+    return jax.jit(score)
+
+
 def make_sharded_best(mesh: Mesh, dp: str = "dp", sp: str = "sp"):
     """Sharded score → per-id argmax → ``[k]`` winners, all on device.
 
@@ -120,6 +179,24 @@ def make_sharded_best(mesh: Mesh, dp: str = "dp", sp: str = "sp"):
     @partial(jax.jit, static_argnames=("k", "n_cand"))
     def best(cand, z_pad, wb, mb, sb, wa, ma, sa, low, high, *, k, n_cand):
         s = score_fn(z_pad, wb, mb, sb, wa, ma, sa, low, high)
+        s = s[: k * n_cand].reshape(k, n_cand)
+        c = cand[: k * n_cand].reshape(k, n_cand)
+        idx = jnp.argmax(s, axis=1)
+        return jnp.take_along_axis(c, idx[:, None], axis=1)[:, 0]
+
+    return best
+
+
+def make_sharded_best_quantized(
+    mesh: Mesh, log_scale: bool, dp: str = "dp", sp: str = "sp"
+):
+    """Quantized-dist variant of :func:`make_sharded_best` (bucket-
+    integral scorer; candidates are RAW values, not log-space)."""
+    score_fn = make_sharded_quantized_score(mesh, log_scale, dp, sp)
+
+    @partial(jax.jit, static_argnames=("k", "n_cand"))
+    def best(cand, x_pad, wb, mb, sb, wa, ma, sa, low, high, q, *, k, n_cand):
+        s = score_fn(x_pad, wb, mb, sb, wa, ma, sa, low, high, q)
         s = s[: k * n_cand].reshape(k, n_cand)
         c = cand[: k * n_cand].reshape(k, n_cand)
         idx = jnp.argmax(s, axis=1)

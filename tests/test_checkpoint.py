@@ -1,0 +1,120 @@
+"""Orbax structured trials checkpointing (SURVEY §7 option; the pickle
+trials_save_file path keeps reference semantics and is tested in
+test_fmin.py)."""
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu.algos import rand, tpe
+from hyperopt_tpu.checkpoint import TrialsCheckpointer, is_orbax_path
+
+
+def _space():
+    return {"x": hp.uniform("x", -5, 5)}
+
+
+def _loss(d):
+    return (d["x"] - 3) ** 2
+
+
+class TestCheckpointer:
+    def test_is_orbax_path(self):
+        assert is_orbax_path("run.orbax")
+        assert not is_orbax_path("run.pkl")
+        assert not is_orbax_path("")
+
+    def test_roundtrip_preserves_docs(self, tmp_path):
+        trials = Trials()
+        fmin(_loss, _space(), algo=rand.suggest, max_evals=12, trials=trials,
+             rstate=np.random.default_rng(0), show_progressbar=False,
+             verbose=False)
+        ckpt = TrialsCheckpointer(str(tmp_path / "t.orbax"))
+        assert ckpt.save(trials)
+        restored = ckpt.restore()
+        assert len(restored.trials) == 12
+        # docs round-trip including datetimes and losses
+        for a, b in zip(trials.trials, restored.trials):
+            assert a["tid"] == b["tid"]
+            assert a["result"]["loss"] == pytest.approx(b["result"]["loss"])
+            assert a["book_time"] == b["book_time"]
+        assert restored.argmin == trials.argmin
+
+    def test_same_step_is_noop(self, tmp_path):
+        trials = Trials()
+        fmin(_loss, _space(), algo=rand.suggest, max_evals=5, trials=trials,
+             rstate=np.random.default_rng(0), show_progressbar=False,
+             verbose=False)
+        ckpt = TrialsCheckpointer(str(tmp_path / "t.orbax"))
+        assert ckpt.save(trials) is True
+        assert ckpt.save(trials) is False  # no new trials -> no new step
+
+    def test_retention(self, tmp_path):
+        ckpt = TrialsCheckpointer(str(tmp_path / "t.orbax"), max_to_keep=2)
+        trials = Trials()
+        for n in (4, 8, 12):
+            fmin(_loss, _space(), algo=rand.suggest, max_evals=n,
+                 trials=trials, rstate=np.random.default_rng(0),
+                 show_progressbar=False, verbose=False)
+            ckpt.save(trials)
+        assert ckpt.steps() == [2, 3]  # oldest step retired
+
+    def test_in_place_result_mutation_triggers_save(self, tmp_path):
+        """Async backends fill results into EXISTING docs (len unchanged);
+        the change detector must still persist them."""
+        from hyperopt_tpu.base import JOB_STATE_DONE, JOB_STATE_NEW
+
+        trials = Trials()
+        fmin(_loss, _space(), algo=rand.suggest, max_evals=6, trials=trials,
+             rstate=np.random.default_rng(0), show_progressbar=False,
+             verbose=False)
+        ckpt = TrialsCheckpointer(str(tmp_path / "t.orbax"))
+        # simulate an in-flight async doc
+        doc = trials.trials[-1]
+        doc["state"] = JOB_STATE_NEW
+        saved_result = doc["result"]
+        doc["result"] = {}
+        trials.refresh()
+        assert ckpt.save(trials) is True
+        # worker completes the SAME doc in place
+        doc["state"] = JOB_STATE_DONE
+        doc["result"] = saved_result
+        trials.refresh()
+        assert ckpt.save(trials) is True  # would be lost by a len check
+        restored = ckpt.restore()
+        assert restored.trials[-1]["result"]["loss"] == pytest.approx(
+            saved_result["loss"]
+        )
+
+    def test_restore_into_preserves_subclass(self, tmp_path):
+        trials = Trials()
+        fmin(_loss, _space(), algo=rand.suggest, max_evals=5, trials=trials,
+             rstate=np.random.default_rng(0), show_progressbar=False,
+             verbose=False)
+        ckpt = TrialsCheckpointer(str(tmp_path / "t.orbax"))
+        ckpt.save(trials)
+
+        class MyTrials(Trials):
+            pass
+
+        mine = MyTrials()
+        out = ckpt.restore(into=mine)
+        assert out is mine
+        assert isinstance(out, MyTrials)
+        assert len(out.trials) == 5
+
+
+class TestFminIntegration:
+    def test_fmin_saves_and_resumes(self, tmp_path):
+        path = str(tmp_path / "run.orbax")
+        fmin(_loss, _space(), algo=tpe.suggest, max_evals=8,
+             trials_save_file=path, rstate=np.random.default_rng(1),
+             show_progressbar=False, verbose=False)
+        ckpt = TrialsCheckpointer(path)
+        assert ckpt.restore() is not None
+        assert len(ckpt.restore().trials) == 8
+        # resume: a fresh fmin continues from the checkpoint
+        fmin(_loss, _space(), algo=tpe.suggest, max_evals=15,
+             trials_save_file=path, rstate=np.random.default_rng(1),
+             show_progressbar=False, verbose=False)
+        assert len(TrialsCheckpointer(path).restore().trials) == 15

@@ -331,14 +331,20 @@ class TestShardedSuggest:
             assert a[0]["misc"]["vals"] != b[0]["misc"]["vals"], mesh
             assert -5.0 <= a[0]["misc"]["vals"]["x"][0] <= 5.0
 
-    def test_mesh_quantized_fallthrough_warns(self, caplog):
-        """Quantized labels silently dropped mesh sharding before; now a
-        warning is logged once per label."""
-        import logging
+    def test_mesh_quantized_labels_shard(self):
+        """Quantized dists shard through the CDF-bucket scorer (no more
+        single-device fallthrough): mesh suggestions stay on the q-grid,
+        in bounds, and the sharded scorer agrees with the exact
+        single-device quantized lpdf."""
+        import jax.numpy as jnp
 
         from hyperopt_tpu import Domain, hp
-        from hyperopt_tpu.algos.tpe import _warned_quantized
-        from hyperopt_tpu.parallel.sharding import default_mesh
+        from hyperopt_tpu.ops import gmm as gmm_ops
+        from hyperopt_tpu.parallel.sharding import (
+            default_mesh,
+            make_sharded_quantized_score,
+            pad_mixture,
+        )
 
         space = {"w": hp.quniform("w", 0, 100, 5)}
         trials = Trials()
@@ -348,12 +354,44 @@ class TestShardedSuggest:
             show_progressbar=False, verbose=False,
         )
         domain = Domain(lambda c: abs(c["w"] - 40) / 20, space)
-        _warned_quantized.discard("w")
-        with caplog.at_level(logging.WARNING, logger="hyperopt_tpu.algos.tpe"):
-            tpe.suggest([400], domain, trials, seed=11, mesh=default_mesh())
-        assert any("quantized label 'w'" in r.message for r in caplog.records)
-        # once per label only
-        caplog.clear()
-        with caplog.at_level(logging.WARNING, logger="hyperopt_tpu.algos.tpe"):
-            tpe.suggest([401], domain, trials, seed=12, mesh=default_mesh())
-        assert not any("quantized label" in r.message for r in caplog.records)
+        mesh = default_mesh()
+        docs = tpe.suggest([400, 401], domain, trials, seed=11, mesh=mesh)
+        for doc in docs:
+            w = doc["misc"]["vals"]["w"][0]
+            assert 0.0 <= w <= 100.0
+            assert w % 5 == 0  # on the quantization grid
+
+        # numeric parity of the sharded quantized scorer vs gmm_lpdf
+        sp = int(mesh.shape["sp"])
+        dp = int(mesh.shape["dp"])
+        rng = np.random.default_rng(0)
+        K = 4 * sp
+        w_, mu, sg = (rng.uniform(0.1, 1, K).astype(np.float32),
+                      rng.uniform(0, 100, K).astype(np.float32),
+                      rng.uniform(1, 10, K).astype(np.float32))
+        w_ /= w_.sum()
+        wb, mb, sb = pad_mixture(w_, mu, sg, K)
+        x = (np.round(rng.uniform(0, 100, 8 * dp) / 5) * 5).astype(np.float32)
+        lo, hi, q = np.float32(0.0), np.float32(100.0), np.float32(5.0)
+        sharded = np.asarray(
+            make_sharded_quantized_score(mesh, log_scale=False)(
+                x, wb, mb, sb, wb, mb, sb, lo, hi, q
+            )
+        )
+        # l == g mixture -> score exactly 0; also check one-sided value
+        np.testing.assert_allclose(sharded, 0.0, atol=1e-5)
+        exact = np.asarray(
+            gmm_ops.gmm_lpdf(x, wb, mb, sb, lo, hi, q, False, True)
+        )
+        one_sided = np.asarray(
+            make_sharded_quantized_score(mesh, log_scale=False)(
+                x, wb, mb, sb,
+                np.ones(K, np.float32) / K, mb, sb, lo, hi, q,
+            )
+        )
+        ga = np.asarray(
+            gmm_ops.gmm_lpdf(
+                x, np.ones(K, np.float32) / K, mb, sb, lo, hi, q, False, True
+            )
+        )
+        np.testing.assert_allclose(one_sided, exact - ga, atol=1e-4)
