@@ -424,6 +424,23 @@ def main():
 
     flops = _scorer_flops(dh, N_EI_CANDIDATES)
 
+    # --- batched suggest (JaxTrials production mode): k trials per
+    # dispatch amortizes the per-call host/tunnel overhead -------------
+    kb = int(os.environ.get("BENCH_BATCH_K", 32))
+    _ = tpe.suggest(
+        [N_HISTORY + 10_000 + i for i in range(kb)], domain, trials, 0,
+        n_EI_candidates=N_EI_CANDIDATES,
+    )  # warm
+    t0 = time.perf_counter()
+    breps = 5
+    for r in range(breps):
+        tpe.suggest(
+            [N_HISTORY + 20_000 + r * kb + i for i in range(kb)],
+            domain, trials, r, n_EI_candidates=N_EI_CANDIDATES,
+        )
+    batched_per = (time.perf_counter() - t0) / breps
+    batched_rate = kb / batched_per
+
     # --- device-plane scorer throughput (tunnel-safe, amortized) ------
     ab, device_ei_rate = _device_scorer_bench(rtt, cap_b, platform)
     # per-suggest pair-scorer EI evals: continuous non-quantized families
@@ -468,6 +485,8 @@ def main():
         "n_EI_candidates": N_EI_CANDIDATES,
         "suggests_per_sec_driver_loop": round(suggests_per_sec, 3),
         "xla_ms_per_suggest_driver_loop": round(xla_per_suggest * 1e3, 3),
+        "suggests_per_sec_batched": round(batched_rate, 2),
+        "batched_k": kb,
         "device_scorer_ms_per_suggest": (
             round(device_ms_per_suggest_scorer, 3)
             if device_ms_per_suggest_scorer is not None

@@ -424,6 +424,40 @@ def _continuous_best_sharded(
     return np.asarray(best)
 
 
+# bounded-quantized families with at most this many grid values score on
+# the bucket grid (one exact lpdf per DISTINCT value, gathered per
+# candidate) instead of per candidate — see tpe_device n_buckets
+_MAX_GRID_BUCKETS = 4096
+
+
+def _family_bucket_count(fam, n_candidates):
+    """Static distinct-value count for a bounded quantized family (the
+    max over its labels, +3 margin for grid-edge rounding), or 0 when
+    any label is unbounded, the grid exceeds _MAX_GRID_BUCKETS, or it
+    is not smaller than the candidate count (no saving).
+
+    Computed from the family's DEFAULT priors, never lock-narrowed ones:
+    ``n_buckets`` is a static jit argument, so deriving it from
+    per-call values (ATPE soft-lock radii change every call) would
+    recompile the multi-family program per suggest.  An over-wide grid
+    is always safe — the traced ``j0``/bounds place and mask it."""
+    priors = fam.default_priors
+    n_max = 0
+    for i in range(fam.L):
+        lo, hi, q = float(priors[i, 2]), float(priors[i, 3]), float(priors[i, 4])
+        if not (np.isfinite(lo) and np.isfinite(hi)) or q <= 0:
+            return 0
+        if fam.log_scale:
+            lo, hi = np.exp(lo), np.exp(hi)
+        n = int(np.ceil((hi - lo) / q)) + 3
+        if n > _MAX_GRID_BUCKETS:
+            return 0
+        n_max = max(n_max, n)
+    if n_max >= n_candidates:
+        return 0  # grid would cost more than per-candidate scoring
+    return n_max
+
+
 _sharded_scorers = {}
 
 
@@ -686,6 +720,11 @@ def _suggest_device(
                     cap_b=cap_b, k=k, n_cand=int(n_EI_candidates), lf=lf,
                     log_scale=fam.log_scale, quantized=fam.quantized,
                     scorer=scorer,
+                    n_buckets=_family_bucket_count(
+                        fam, k * int(n_EI_candidates)
+                    )
+                    if fam.quantized
+                    else 0,
                 ),
             ))
         else:

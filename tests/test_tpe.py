@@ -410,3 +410,87 @@ class TestObsFiltering:
     def test_trial_filter_bad_shape_raises(self):
         with pytest.raises(ValueError):
             self._suggest_xs(trial_filter=np.ones(3, dtype=bool))
+
+
+class TestBucketGridScoring:
+    """Bounded quantized dists score on the distinct-value grid and
+    gather per candidate; must match the per-candidate exact path."""
+
+    def test_grid_matches_per_candidate(self):
+        import jax
+
+        from hyperopt_tpu.algos import tpe_device as td
+
+        L, CAP, CAPT = 2, 64, 64
+        rng = np.random.default_rng(0)
+        keys = np.asarray(
+            jax.random.split(jax.random.PRNGKey(0), L), np.uint32
+        )
+        # quniform(0, 100, 5) style labels
+        obs = np.round(rng.uniform(0, 100, (L, CAP)) / 5) * 5
+        obs = obs.astype(np.float32)
+        pos = np.tile(np.arange(CAP, dtype=np.int32), (L, 1))
+        counts = np.full(L, 40, np.int32)
+        losses = rng.normal(size=CAPT).astype(np.float32)
+        keep = np.ones(CAPT, bool)
+        priors = np.tile(
+            np.array([50.0, 100.0, 0.0, 100.0, 5.0], np.float32), (L, 1)
+        )
+        lock_c = np.zeros(L, np.float32)
+        lock_r = np.full(L, np.inf, np.float32)
+        args = (
+            keys, obs, pos, counts, losses, keep,
+            np.int32(4), np.float32(1.0), priors, lock_c, lock_r,
+        )
+        common = dict(cap_b=8, k=2, n_cand=128, lf=25,
+                      log_scale=False, quantized=True, scorer="xla")
+        from functools import partial
+
+        per_cand = np.asarray(
+            jax.jit(partial(td._family_suggest_core, n_buckets=0, **common))(*args)
+        )
+        grid = np.asarray(
+            jax.jit(partial(td._family_suggest_core, n_buckets=24, **common))(*args)
+        )
+        np.testing.assert_allclose(grid, per_cand)
+
+    def test_bucket_count_gating(self):
+        from hyperopt_tpu.algos.tpe import _MAX_GRID_BUCKETS, _family_bucket_count
+
+        def fam(pri, log_scale=False):
+            class FakeFam:
+                pass
+
+            f = FakeFam()
+            f.L = len(pri)
+            f.log_scale = log_scale
+            f.default_priors = np.asarray(pri, np.float32)
+            return f
+
+        pri = [[50, 100, 0, 100, 5], [10, 20, 0, 20, 1]]
+        assert _family_bucket_count(fam(pri), 8192) == 23  # ceil(100/5)+3
+        # unbounded label -> 0 (per-candidate path)
+        assert _family_bucket_count(fam([[0, 1, -np.inf, np.inf, 1]]), 8192) == 0
+        # oversized grid -> 0
+        assert _family_bucket_count(
+            fam([[0, 1, 0, 10 * _MAX_GRID_BUCKETS, 1]]), 10**6
+        ) == 0
+        # grid not smaller than the candidate count -> 0 (no saving)
+        assert _family_bucket_count(fam(pri), 16) == 0
+
+    def test_mixed_bounded_unbounded_quantized_split(self):
+        """A qnormal label must not disable the bucket grid for a
+        quniform label — they land in different device families."""
+        from hyperopt_tpu import Domain, hp
+        from hyperopt_tpu.algos import tpe_device as td
+
+        space = {
+            "w": hp.quniform("w", 0, 100, 5),
+            "g": hp.qnormal("g", 0, 10, 1),
+        }
+        domain = Domain(lambda c: 0.0, space)
+        dh = td.DeviceHistory(domain.space.specs)
+        quant_keys = [k for k in dh.families if k[0] == "cont" and k[2]]
+        assert len(quant_keys) == 2  # split by boundedness
+        bounded = [k for k in quant_keys if k[3]]
+        assert len(bounded) == 1
