@@ -50,6 +50,25 @@ from .algos import anneal, atpe, criteria, mix, rand, tpe
 from .early_stop import no_progress_loss
 from .parallel import FileTrials, JaxTrials
 
+
+def __getattr__(name):
+    # migration guidance for reference-hyperopt users: the Mongo/Spark
+    # backends are delivered by TPU-native analogs, not ports
+    if name == "MongoTrials":
+        raise AttributeError(
+            "hyperopt_tpu has no MongoTrials: the durable multi-worker "
+            "queue is FileTrials (shared-filesystem analog of the Mongo "
+            "backend; workers run `hyperopt-tpu-worker --queue DIR`). "
+            "Use hyperopt_tpu.FileTrials."
+        )
+    if name == "SparkTrials":
+        raise AttributeError(
+            "hyperopt_tpu has no SparkTrials: concurrent trial execution "
+            "is JaxTrials(parallelism=N) (thread dispatcher + optional "
+            "on-device vectorized evaluation). Use hyperopt_tpu.JaxTrials."
+        )
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 __version__ = "0.1.0"
 
 __all__ = [
