@@ -51,23 +51,39 @@ from .early_stop import no_progress_loss
 from .parallel import FileTrials, JaxTrials
 
 
-def __getattr__(name):
-    # migration guidance for reference-hyperopt users: the Mongo/Spark
-    # backends are delivered by TPU-native analogs, not ports
-    if name == "MongoTrials":
-        raise AttributeError(
-            "hyperopt_tpu has no MongoTrials: the durable multi-worker "
-            "queue is FileTrials (shared-filesystem analog of the Mongo "
-            "backend; workers run `hyperopt-tpu-worker --queue DIR`). "
-            "Use hyperopt_tpu.FileTrials."
+# migration stubs for reference-hyperopt users: the Mongo/Spark backends
+# are delivered by TPU-native analogs, not ports.  Real (but
+# unconstructable) classes, not module __getattr__, because the common
+# migration form `from hyperopt import MongoTrials` swallows
+# AttributeError into a bare ImportError and would lose the guidance.
+
+
+class MongoTrials:
+    """Not provided — use :class:`FileTrials`.
+
+    The durable multi-worker queue is FileTrials (shared-filesystem
+    analog of the reference's Mongo backend; workers run
+    ``hyperopt-tpu-worker --queue DIR``)."""
+
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "hyperopt_tpu has no MongoTrials: use FileTrials(queue_dir) — "
+            "the durable shared-filesystem work queue (workers: "
+            "`hyperopt-tpu-worker --queue DIR`)."
         )
-    if name == "SparkTrials":
-        raise AttributeError(
-            "hyperopt_tpu has no SparkTrials: concurrent trial execution "
-            "is JaxTrials(parallelism=N) (thread dispatcher + optional "
-            "on-device vectorized evaluation). Use hyperopt_tpu.JaxTrials."
+
+
+class SparkTrials:
+    """Not provided — use :class:`JaxTrials`.
+
+    Concurrent trial execution is JaxTrials(parallelism=N) (thread
+    dispatcher + optional on-device vectorized evaluation)."""
+
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "hyperopt_tpu has no SparkTrials: use JaxTrials(parallelism=N) "
+            "— concurrent trials with an optional on-device batch plane."
         )
-    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __version__ = "0.1.0"
 
