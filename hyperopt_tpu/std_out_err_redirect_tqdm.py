@@ -24,6 +24,19 @@ class DummyTqdmFile:
     def flush(self):
         return getattr(self.file, "flush", lambda: None)()
 
+    def close(self):
+        # never close the wrapped real stream: logging handlers that
+        # captured this object while redirection was active call close()
+        # at interpreter shutdown, and closing sys.__stdout__/__stderr__
+        # underneath everyone else would be worse than the leak
+        pass
+
+    def isatty(self):
+        return getattr(self.file, "isatty", lambda: False)()
+
+    def fileno(self):
+        return self.file.fileno()
+
 
 @contextlib.contextmanager
 def std_out_err_redirect_tqdm():

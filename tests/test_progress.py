@@ -1,0 +1,66 @@
+"""Progress-callback tests (reference parity: ``hyperopt/tests/test_progress.py``
+asserts the callback context manager is entered and ``.update`` is invoked
+once per finished trial; SURVEY.md §2 #20)."""
+
+import contextlib
+
+import numpy as np
+
+from hyperopt_tpu import Trials, fmin, hp, rand
+from hyperopt_tpu import progress
+
+
+def test_no_progress_callback_handle_is_inert():
+    with progress.no_progress_callback(initial=0, total=5) as handle:
+        handle.update(3)  # no-op, must not raise
+        assert handle.postfix is None
+        handle.postfix = "best: 1.0"  # setter also inert
+
+
+def test_tqdm_progress_callback_updates_and_postfix(capsys):
+    with progress.tqdm_progress_callback(initial=0, total=4) as handle:
+        handle.update(2)
+        handle.postfix = "best loss: 0.5"
+        assert "best loss: 0.5" in str(handle.postfix)
+        handle.postfix = None  # clears without raising
+
+
+def test_fmin_invokes_custom_progress_callback(monkeypatch):
+    """fmin(show_progressbar=True) must route through
+    ``progress.default_callback``, update once per finished trial, and set
+    a best-loss postfix."""
+    calls = {"entered": 0, "updates": [], "postfix": []}
+
+    class Handle:
+        def update(self, n):
+            calls["updates"].append(n)
+
+        @property
+        def postfix(self):
+            return None
+
+        @postfix.setter
+        def postfix(self, value):
+            calls["postfix"].append(value)
+
+    @contextlib.contextmanager
+    def recording_callback(initial, total):
+        calls["entered"] += 1
+        calls["total"] = total
+        yield Handle()
+
+    monkeypatch.setattr(progress, "default_callback", recording_callback)
+
+    fmin(
+        fn=lambda x: x**2,
+        space=hp.uniform("x", -1, 1),
+        algo=rand.suggest,
+        max_evals=7,
+        trials=Trials(),
+        rstate=np.random.default_rng(0),
+        show_progressbar=True,
+    )
+    assert calls["entered"] == 1
+    assert calls["total"] == 7
+    assert sum(calls["updates"]) == 7
+    assert calls["postfix"], "best-loss postfix never set"
