@@ -40,6 +40,7 @@ import json
 import os
 import sys
 import time
+from functools import partial
 
 import numpy as np
 
@@ -353,7 +354,11 @@ def _device_scorer_bench(rtt, cap_b, platform):
         k_real = (min(LF, n_hist) + 1) + (n_hist + 1)
         scorers = [("xla", pair_score)]
         if platform == "tpu":
-            scorers.append(("pallas", pair_score_pallas))
+            # mxu vs fma: same online-logsumexp kernel, quadratic evaluated
+            # on the MXU (multi-pass HIGHEST dot, contraction dim 3 padded
+            # to 128) vs as VPU broadcast FMAs (exact f32, no dead lanes)
+            scorers.append(("pallas", partial(pair_score_pallas, fma=False)))
+            scorers.append(("pallas_fma", partial(pair_score_pallas, fma=True)))
         for n_cand in (8_192, 65_536):
             z = jnp.asarray(rng.normal(size=n_cand).astype(np.float32))
             for name, fn in scorers:

@@ -56,8 +56,20 @@ def _mix_update(comp, m, s):
     return new_m, s
 
 
-def _region_logsumexp(f, p_ref, start: int, size: int, tk: int, lead=None):
-    """Online logsumexp of ``f @ P[:, start:start+size]`` tiled by ``tk``."""
+def _region_logsumexp(f, p_ref, start: int, size: int, tk: int, lead=None,
+                      fma: bool = False):
+    """Online logsumexp of ``f @ P[:, start:start+size]`` tiled by ``tk``.
+
+    ``fma=False``: MXU dot_general. The contraction dim is 3, which the
+    MXU pads to 128 (≈43× wasted lanes), and HIGHEST forces multi-pass
+    true-f32 — default bf16 passes lose ~1e0 absolute on 10k-component
+    logsumexps, which would randomize the EI argmax.
+    ``fma=True``: the same quadratic as two broadcast FMAs + add on the
+    VPU — exact f32 with no multi-pass and no dead MXU lanes. Bitwise
+    different summation order but ≤1 ulp-class difference; selected via
+    the measured A/B in ``bench.py _device_scorer_bench`` (the
+    ``scorer_ab`` output keys).
+    """
     TC = f.shape[0]
 
     def body(j, carry):
@@ -66,16 +78,20 @@ def _region_logsumexp(f, p_ref, start: int, size: int, tk: int, lead=None):
             tile = p_ref[:, pl.ds(start + j * tk, tk)]
         else:
             tile = p_ref[lead, :, pl.ds(start + j * tk, tk)]
-        # contraction dim is 3 → bandwidth-bound; HIGHEST forces true-f32
-        # passes (default bf16 passes lose ~1e0 absolute on 10k-component
-        # logsumexps, which would randomize the EI argmax)
-        comp = jax.lax.dot_general(
-            f,
-            tile,
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
+        if fma:
+            comp = (
+                f[:, 0:1] * tile[0:1, :]
+                + f[:, 1:2] * tile[1:2, :]
+                + tile[2:3, :]
+            )
+        else:
+            comp = jax.lax.dot_general(
+                f,
+                tile,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
         return _mix_update(comp, m, s)
 
     init = (jnp.full((TC,), NEG_BIG, jnp.float32), jnp.zeros((TC,), jnp.float32))
@@ -83,17 +99,19 @@ def _region_logsumexp(f, p_ref, start: int, size: int, tk: int, lead=None):
     return m + jnp.log(jnp.maximum(s, 1e-300))
 
 
-def _kernel(f_ref, p_ref, out_ref, *, KB: int, KA: int, TKB: int, TKA: int):
+def _kernel(f_ref, p_ref, out_ref, *, KB: int, KA: int, TKB: int, TKA: int,
+            fma: bool):
     f = f_ref[...]  # [TC, 3]
-    ll_b = _region_logsumexp(f, p_ref, 0, KB, TKB)
-    ll_a = _region_logsumexp(f, p_ref, KB, KA, TKA)
+    ll_b = _region_logsumexp(f, p_ref, 0, KB, TKB, fma=fma)
+    ll_a = _region_logsumexp(f, p_ref, KB, KA, TKA, fma=fma)
     out_ref[...] = (ll_b - ll_a)[:, None]
 
 
-def _kernel_batched(f_ref, p_ref, out_ref, *, KB: int, KA: int, TKB: int, TKA: int):
+def _kernel_batched(f_ref, p_ref, out_ref, *, KB: int, KA: int, TKB: int,
+                    TKA: int, fma: bool):
     f = f_ref[0]  # [TC, 3]
-    ll_b = _region_logsumexp(f, p_ref, 0, KB, TKB, lead=0)
-    ll_a = _region_logsumexp(f, p_ref, KB, KA, TKA, lead=0)
+    ll_b = _region_logsumexp(f, p_ref, 0, KB, TKB, lead=0, fma=fma)
+    ll_a = _region_logsumexp(f, p_ref, KB, KA, TKA, lead=0, fma=fma)
     out_ref[...] = (ll_b - ll_a).reshape(out_ref.shape)
 
 
@@ -137,12 +155,36 @@ def _features(z, c_pad: int):
     return f
 
 
-@partial(jax.jit, static_argnames=("k_below", "tc", "tk", "interpret"))
+def _default_fma() -> bool:
+    """Kernel-body default for the quadratic evaluation: VPU FMA vs MXU
+    dot. Overridable per call (``fma=``) or process-wide via
+    ``HYPEROPT_TPU_PALLAS_FMA=0/1``; the shipped default is chosen by the
+    measured A/B in ``bench.py`` (``scorer_ab``)."""
+    import os
+
+    v = os.environ.get("HYPEROPT_TPU_PALLAS_FMA")
+    if v is not None:
+        return v not in ("0", "false", "False")
+    return False
+
+
 def pair_score_pallas(
-    z, params_pair, k_below: int, tc: int = 1024, tk: int = 512, interpret=False
+    z, params_pair, k_below: int, tc: int = 1024, tk: int = 512, interpret=False,
+    fma=None,
 ):
     """``log l − log g`` for candidates ``z`` ([C]); same contract as
-    ``ops.score.pair_score``."""
+    ``ops.score.pair_score``.
+
+    ``fma=None`` resolves the env default HERE, outside jit, so flipping
+    ``HYPEROPT_TPU_PALLAS_FMA`` mid-process takes effect on the next call
+    (the resolved bool is the static cache key, never ``None``)."""
+    if fma is None:
+        fma = _default_fma()
+    return _pair_score_pallas(z, params_pair, k_below, tc, tk, interpret, fma)
+
+
+@partial(jax.jit, static_argnames=("k_below", "tc", "tk", "interpret", "fma"))
+def _pair_score_pallas(z, params_pair, k_below: int, tc, tk, interpret, fma):
     C = z.shape[0]
     tkb = _region_tile(k_below, tk)
     tka = _region_tile(params_pair.shape[1] - k_below, tk)
@@ -152,7 +194,7 @@ def pair_score_pallas(
     n_c = fp.shape[0] // tc
 
     out = pl.pallas_call(
-        partial(_kernel, KB=KB, KA=KA, TKB=tkb, TKA=tka),
+        partial(_kernel, KB=KB, KA=KA, TKB=tkb, TKA=tka, fma=fma),
         out_shape=jax.ShapeDtypeStruct((n_c * tc, 1), jnp.float32),
         grid=(n_c,),
         in_specs=[
@@ -165,12 +207,20 @@ def pair_score_pallas(
     return out.reshape(-1)[:C]
 
 
-@partial(jax.jit, static_argnames=("k_below", "tc", "tk", "interpret"))
 def pair_score_pallas_batched(
-    z, params_pair, k_below: int, tc: int = 1024, tk: int = 512, interpret=False
+    z, params_pair, k_below: int, tc: int = 1024, tk: int = 512, interpret=False,
+    fma=None,
 ):
     """Label-stacked variant: ``z`` [L, C], ``params_pair`` [L, 3, Kb+Ka]
-    → scores [L, C].  Grid is (labels × candidate tiles)."""
+    → scores [L, C].  Grid is (labels × candidate tiles).  ``fma=None``
+    resolves the env default outside jit (see ``pair_score_pallas``)."""
+    if fma is None:
+        fma = _default_fma()
+    return _pair_score_pallas_batched(z, params_pair, k_below, tc, tk, interpret, fma)
+
+
+@partial(jax.jit, static_argnames=("k_below", "tc", "tk", "interpret", "fma"))
+def _pair_score_pallas_batched(z, params_pair, k_below: int, tc, tk, interpret, fma):
     L, C = z.shape
     tkb = _region_tile(k_below, tk)
     tka = _region_tile(params_pair.shape[2] - k_below, tk)
@@ -180,7 +230,7 @@ def pair_score_pallas_batched(
     n_c = fp.shape[1] // tc
 
     out = pl.pallas_call(
-        partial(_kernel_batched, KB=KB, KA=KA, TKB=tkb, TKA=tka),
+        partial(_kernel_batched, KB=KB, KA=KA, TKB=tkb, TKA=tka, fma=fma),
         out_shape=jax.ShapeDtypeStruct((L, n_c * tc, 1), jnp.float32),
         grid=(L, n_c),
         in_specs=[

@@ -3,7 +3,8 @@
 Reference parity (SURVEY.md §2 #21): ``hyperopt/plotting.py`` —
 ``main_plot_history`` (loss vs trial time, colored by status),
 ``main_plot_histogram``, ``main_plot_vars`` (per-hyperparameter scatter of
-loss with log-scale detection).
+loss with log-scale detection), ``main_plot_1D_attachment`` (per-trial
+1-D attachment curves, darker for lower loss).
 
 matplotlib is imported lazily so headless installs without it can use the
 rest of the framework; pass ``do_show=False`` to compose into figures.
@@ -82,6 +83,70 @@ def main_plot_histogram(trials, do_show=True, title="Loss Histogram"):
     plt.xlabel("loss")
     plt.ylabel("frequency")
     plt.title(f"{title}: {len(status_ok)} ok trials")
+    if do_show:
+        plt.show()
+    return plt.gcf()
+
+
+def main_plot_1D_attachment(
+    trials,
+    attachment_name,
+    do_show=True,
+    colorize_by_loss=True,
+    max_darkness=0.5,
+    num_trials=None,
+    preprocessing_fn=lambda x: x,
+):
+    """One line per trial of a 1-D per-trial attachment (e.g. a learning
+    curve stored via ``ctrl.attachments[name] = …``), darker for lower
+    loss (reference parity: ``hyperopt/plotting.py —
+    main_plot_1D_attachment``).
+
+    ``preprocessing_fn`` maps the stored attachment value (often pickled
+    bytes) to a 1-D sequence; ``num_trials`` limits to the most recent N.
+    """
+    plt = _plt()
+    docs = trials.trials if num_trials is None else trials.trials[-num_trials:]
+    losses = [
+        t["result"].get("loss")
+        for t in docs
+        if t["result"].get("status") == STATUS_OK
+        and t["result"].get("loss") is not None
+    ]
+    lo = min(losses) if losses else 0.0
+    hi = max(losses) if losses else 1.0
+    span = (hi - lo) or 1.0
+    n_plotted = 0
+    for t in docs:
+        att = trials.trial_attachments(t)
+        if attachment_name not in att:
+            continue
+        ys = np.asarray(preprocessing_fn(att[attachment_name]), dtype=float)
+        if ys.ndim != 1:
+            logger.warning(
+                "main_plot_1D_attachment: %r on tid %s is not 1-D (shape %s)",
+                attachment_name, t.get("tid"), ys.shape,
+            )
+            continue
+        loss = t["result"].get("loss")
+        if colorize_by_loss and loss is not None:
+            # lo/hi come from OK trials only, but any doc may carry the
+            # attachment (e.g. a failed trial with a worse loss) — clamp
+            # so the alpha stays a valid color component
+            frac = (float(loss) - lo) / span
+            darkness = max_darkness * min(1.0, max(0.0, 1.0 - frac))
+        else:
+            darkness = max_darkness
+        plt.plot(ys, color=(0.0, 0.0, 0.0, min(1.0, darkness + 0.1)))
+        n_plotted += 1
+    if not n_plotted:
+        logger.warning(
+            "main_plot_1D_attachment: no trials carry attachment %r",
+            attachment_name,
+        )
+    plt.xlabel("index")
+    plt.ylabel(attachment_name)
+    plt.title(f"{attachment_name} across {n_plotted} trials")
     if do_show:
         plt.show()
     return plt.gcf()

@@ -106,3 +106,44 @@ def test_fminiter_records_timings():
     s = it.timings.summary()
     assert s["suggest"]["count"] == 5
     assert s["evaluate"]["count"] >= 1
+
+
+def test_plot_1d_attachment(run_trials):
+    from hyperopt_tpu.plotting import main_plot_1D_attachment
+
+    # attach a synthetic learning curve to every third trial
+    for t in run_trials.trials[::3]:
+        run_trials.trial_attachments(t)["curve"] = np.linspace(
+            t["result"]["loss"] + 1.0, t["result"]["loss"], 20
+        )
+    fig = main_plot_1D_attachment(run_trials, "curve", do_show=False)
+    assert fig is not None
+    assert len(fig.gca().lines) == len(run_trials.trials[::3])
+    matplotlib.pyplot.close("all")
+
+
+def test_plot_1d_attachment_absent_warns(run_trials, caplog):
+    from hyperopt_tpu.plotting import main_plot_1D_attachment
+
+    with caplog.at_level("WARNING"):
+        main_plot_1D_attachment(run_trials, "nope", do_show=False)
+    assert any("nope" in r.message for r in caplog.records)
+    matplotlib.pyplot.close("all")
+
+
+def test_plot_1d_attachment_non_ok_trial_alpha_clamped(run_trials):
+    # a failed trial with a loss worse than every OK loss must not
+    # produce a negative alpha (regression: ValueError from matplotlib)
+    from hyperopt_tpu.plotting import main_plot_1D_attachment
+
+    bad = run_trials.trials[0]
+    worst = max(t["result"]["loss"] for t in run_trials.trials)
+    orig = dict(bad["result"])
+    bad["result"] = {"status": "fail", "loss": worst + 100.0}
+    try:
+        run_trials.trial_attachments(bad)["curve2"] = np.linspace(1, 0, 5)
+        fig = main_plot_1D_attachment(run_trials, "curve2", do_show=False)
+        assert fig is not None
+    finally:
+        bad["result"] = orig
+    matplotlib.pyplot.close("all")

@@ -115,3 +115,47 @@ def test_pallas_batched_matches_single():
         )
     )
     np.testing.assert_allclose(batched, np.stack(singles), atol=2e-5)
+
+
+@pytest.mark.parametrize("C,K,tc,tk", [(100, 37, 32, 128), (600, 300, 256, 256)])
+def test_pallas_fma_variant_matches_exact(C, K, tc, tk):
+    # the VPU-FMA quadratic evaluation must be numerically equivalent to
+    # the MXU dot path (different summation order, same f32 math)
+    below, above = make_pair(K=K, padded_tail=4)
+    z = np.random.default_rng(6).uniform(-4, 4, C).astype(np.float32)
+    ref = exact_diff(z, below, above)
+    got = np.asarray(
+        pair_score_pallas(
+            z, pair_params(*below, *above), K, tc=tc, tk=tk,
+            interpret=True, fma=True,
+        )
+    )
+    np.testing.assert_allclose(got, ref, atol=5e-5)
+
+
+def test_pallas_fma_batched_matches_mxu():
+    from hyperopt_tpu.ops.pallas_gmm import pair_score_pallas_batched
+
+    rng = np.random.default_rng(7)
+    L, C, K = 3, 200, 50
+    zs, Ps = [], []
+    for l in range(L):
+        below, above = make_pair(K=K, seed=l, padded_tail=3)
+        zs.append(rng.uniform(-4, 4, C).astype(np.float32))
+        Ps.append(np.asarray(pair_params(*below, *above)))
+    z = np.stack(zs)
+    P = np.stack(Ps)
+    mxu = np.asarray(pair_score_pallas_batched(z, P, K, interpret=True, fma=False))
+    fma = np.asarray(pair_score_pallas_batched(z, P, K, interpret=True, fma=True))
+    np.testing.assert_allclose(fma, mxu, atol=5e-5)
+
+
+def test_pallas_fma_env_default(monkeypatch):
+    from hyperopt_tpu.ops import pallas_gmm
+
+    monkeypatch.delenv("HYPEROPT_TPU_PALLAS_FMA", raising=False)
+    assert pallas_gmm._default_fma() is False
+    monkeypatch.setenv("HYPEROPT_TPU_PALLAS_FMA", "1")
+    assert pallas_gmm._default_fma() is True
+    monkeypatch.setenv("HYPEROPT_TPU_PALLAS_FMA", "0")
+    assert pallas_gmm._default_fma() is False

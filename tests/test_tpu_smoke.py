@@ -132,3 +132,18 @@ def test_tpe_fmin_end_to_end_on_tpu():
     assert len(trials.trials) == 30
     assert trials.best_trial["result"]["loss"] < 10.0
     assert -5.0 <= best["x"] <= 5.0
+
+
+def test_pallas_fma_variant_lowers_and_matches_f64():
+    # the VPU-FMA quadratic path must lower under Mosaic and agree with
+    # the f64 truth as tightly as the MXU dot path
+    from hyperopt_tpu.ops.pallas_gmm import pair_score_pallas
+    from hyperopt_tpu.ops.score import pair_params
+
+    rng = np.random.default_rng(2)
+    kb, ka, C = 25, 999, 513
+    params = pair_params(*_mk_mixture(rng, kb), *_mk_mixture(rng, ka))
+    z = jnp.asarray(rng.normal(size=C).astype(np.float32))
+    got = np.asarray(pair_score_pallas(z, params, kb, fma=True))
+    ref = _truth_pair_score(z, params, kb)
+    np.testing.assert_allclose(got, ref, atol=1e-3)

@@ -1,0 +1,11 @@
+"""Module-level objective for the subprocess-worker E2E test.
+
+Lives in its own importable module (not the test file) because the
+driver pickles the Domain by reference into the queue's attachment blob
+(reference semantics: the mongo 'domain_attachment' GridFS blob) and the
+worker *process* must re-import it.
+"""
+
+
+def quad_objective(cfg):
+    return (cfg["x"] - 3.0) ** 2
