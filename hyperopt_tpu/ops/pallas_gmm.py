@@ -69,6 +69,11 @@ def _region_logsumexp(f, p_ref, start: int, size: int, tk: int, lead=None,
     different summation order but ≤1 ulp-class difference; selected via
     the measured A/B in ``bench.py _device_scorer_bench`` (the
     ``scorer_ab`` output keys).
+
+    The FMA branch REQUIRES ``f[:, 2] == 1`` (it adds the constant row
+    unscaled) — true for :func:`_features` rows; zero-padded candidate
+    rows get a wrong-but-sliced-off score. The MXU branch is a general
+    ``f @ P``.
     """
     TC = f.shape[0]
 
@@ -164,7 +169,7 @@ def _default_fma() -> bool:
 
     v = os.environ.get("HYPEROPT_TPU_PALLAS_FMA")
     if v is not None:
-        return v not in ("0", "false", "False")
+        return v.strip().lower() in ("1", "true", "yes", "on")
     return False
 
 

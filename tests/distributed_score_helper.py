@@ -1,0 +1,85 @@
+"""Subprocess body for the 2-process jax.distributed test.
+
+Each process owns 2 virtual CPU devices; together they form the (2, 2)
+dp×sp global mesh. The PRODUCTION sharded scorer
+(`parallel.sharding.make_sharded_score`) then runs as one SPMD program:
+candidates split over dp (one process's devices never see the other's
+candidates), mixture components split over sp, and the blockwise
+logsumexp's pmax/psum collectives cross the process boundary over the
+Gloo transport — the CPU stand-in for DCN.
+
+Usage: python distributed_score_helper.py <process_id> <coordinator_port>
+Prints DIST_SCORE_OK on success; any assert kills the exit code.
+"""
+
+import os
+import sys
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from hyperopt_tpu.parallel import distributed
+from hyperopt_tpu.parallel.sharding import make_sharded_score
+
+assert distributed.initialize(
+    coordinator_address=f"localhost:{port}", num_processes=2, process_id=pid
+)
+assert jax.process_count() == 2
+assert len(jax.devices()) == 4
+assert distributed.is_coordinator() == (pid == 0)
+
+mesh = distributed.global_mesh(shape=(2, 2))
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+rng = np.random.default_rng(0)  # same seed in both processes: SPMD inputs
+C, K = 8, 16
+cand = rng.uniform(-2, 2, C).astype(np.float32)
+mk = lambda: (
+    (lambda a: (a / a.sum()).astype(np.float32))(np.abs(rng.normal(size=K)) + 0.1),
+    rng.normal(size=K).astype(np.float32),
+    (np.abs(rng.normal(size=K)) + 0.2).astype(np.float32),
+)
+below, above = mk(), mk()
+low, high = np.float32(-4.0), np.float32(4.0)
+
+
+def garr(x, spec):
+    x = np.asarray(x)
+    return jax.make_array_from_callback(
+        x.shape, NamedSharding(mesh, spec), lambda idx: x[idx]
+    )
+
+
+scorer = make_sharded_score(mesh)
+out = scorer(
+    garr(cand, P("dp")),
+    *[garr(a, P("sp")) for a in below],
+    *[garr(a, P("sp")) for a in above],
+    garr(low, P()),
+    garr(high, P()),
+)
+
+# exact reference from the single-device density (both processes compute
+# the full answer from the shared numpy inputs)
+from hyperopt_tpu.ops.gmm import gmm_lpdf
+
+ref = np.asarray(
+    gmm_lpdf(cand, *below, low, high, 0.0, False, False)
+) - np.asarray(gmm_lpdf(cand, *above, low, high, 0.0, False, False))
+
+# each process checks the shards it can address (its own dp rows)
+for shard in out.addressable_shards:
+    idx = shard.index[0]
+    np.testing.assert_allclose(np.asarray(shard.data), ref[idx], atol=1e-4)
+
+print(f"DIST_SCORE_OK pid={pid}", flush=True)

@@ -315,23 +315,7 @@ class TestSubprocessWorkers:
             + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
         )
         env["JAX_PLATFORMS"] = "cpu"
-        logs = [open(tmp_path / f"worker{i}.log", "w+") for i in range(2)]
-        procs = [
-            subprocess.Popen(
-                [
-                    sys.executable, "-m", "hyperopt_tpu.parallel.worker",
-                    "--queue", qdir,
-                    "--poll-interval", "0.05",
-                    "--reserve-timeout", "20",
-                    "--workdir", str(tmp_path / f"w{i}"),
-                ],
-                env=env,
-                cwd=repo,
-                stdout=logs[i],
-                stderr=subprocess.STDOUT,
-            )
-            for i in range(2)
-        ]
+        logs, procs = [], []
 
         def worker_logs():
             out = []
@@ -342,6 +326,23 @@ class TestSubprocessWorkers:
             return "\n".join(out)
 
         try:
+            for i in range(2):
+                logs.append(open(tmp_path / f"worker{i}.log", "w+"))
+                procs.append(
+                    subprocess.Popen(
+                        [
+                            sys.executable, "-m", "hyperopt_tpu.parallel.worker",
+                            "--queue", qdir,
+                            "--poll-interval", "0.05",
+                            "--reserve-timeout", "20",
+                            "--workdir", str(tmp_path / f"w{i}"),
+                        ],
+                        env=env,
+                        cwd=repo,
+                        stdout=logs[i],
+                        stderr=subprocess.STDOUT,
+                    )
+                )
             trials = FileTrials(qdir)
             # fmin's own whole-run timeout is the watchdog: dead workers
             # leave jobs NEW and the loop exits instead of polling forever
@@ -350,22 +351,22 @@ class TestSubprocessWorkers:
                 trials=trials, rstate=np.random.default_rng(0),
                 show_progressbar=False, verbose=False, timeout=90,
             )
+            trials.refresh()
+            assert len(trials) == 12, worker_logs()
+            assert all(
+                t["state"] == JOB_STATE_DONE for t in trials.trials
+            ), worker_logs()
+            assert abs(best["x"] - 3) < 2.5
+            # every trial executed exactly once, by a real worker process
+            # (owner stamped host:pid at reservation); with 2 workers the
+            # split is usually but not deterministically 2-way, so only
+            # the stamping itself is asserted
+            owners = {t["owner"] for t in trials.trials}
+            assert owners and all(o for o in owners), owners
         finally:
             for p in procs:
                 p.terminate()
             for p in procs:
                 p.wait(timeout=10)
-        trials.refresh()
-        assert len(trials) == 12, worker_logs()
-        assert all(
-            t["state"] == JOB_STATE_DONE for t in trials.trials
-        ), worker_logs()
-        assert abs(best["x"] - 3) < 2.5
-        # every trial executed exactly once, by a real worker process
-        # (owner stamped host:pid at reservation); with 2 workers the
-        # split is usually but not deterministically 2-way, so only the
-        # stamping itself is asserted
-        owners = {t["owner"] for t in trials.trials}
-        assert owners and all(o for o in owners), owners
-        for f in logs:
-            f.close()
+            for f in logs:
+                f.close()
