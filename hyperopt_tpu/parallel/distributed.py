@@ -60,7 +60,15 @@ def is_coordinator():
 
 def global_mesh(axis_names=("dp", "sp"), shape=None):
     """Mesh over ALL devices in the distributed runtime (every host must
-    call this with the same arguments — standard SPMD contract)."""
+    call this with the same arguments — standard SPMD contract).
+
+    Device order is process-major, so with the default (dp, sp) axes the
+    trailing ``sp`` axis stays within a host's local devices: the
+    component-axis psum/pmax (the scorer's only collectives) ride ICI,
+    while ``dp`` — which needs no communication — spans hosts/DCN. The
+    cross-process collective transport itself is exercised by the test
+    suite with a deliberately transposed grid
+    (``tests/distributed_score_helper.py``)."""
     from .sharding import default_mesh
 
     import jax

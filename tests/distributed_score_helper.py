@@ -37,7 +37,23 @@ assert jax.process_count() == 2
 assert len(jax.devices()) == 4
 assert distributed.is_coordinator() == (pid == 0)
 
-mesh = distributed.global_mesh(shape=(2, 2))
+# the production orientation (global_mesh, process-major) deliberately
+# keeps sp INTRA-process — the component-axis psum/pmax are the heavy
+# collectives and belong on the fast interconnect (ICI), while dp needs
+# no communication at all. That layout would let this test pass without
+# any cross-process traffic, so here the device grid is TRANSPOSED to
+# force every sp collective across the process (Gloo/DCN) boundary.
+import numpy as _np
+from jax.sharding import Mesh
+
+_grid = _np.array(jax.devices()).reshape(2, 2).T
+assert {d.process_index for d in _grid[0]} == {0, 1}, "sp must span processes"
+mesh = Mesh(_grid, ("dp", "sp"))
+
+# the production helper still builds (and is pinned by) the ICI-friendly
+# orientation
+_prod = distributed.global_mesh(shape=(2, 2))
+assert _prod.devices.shape == (2, 2)
 
 from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -432,24 +432,33 @@ class TestMultiProcessDistributed:
                 out, _ = p.communicate(timeout=240)
                 outs.append(out)
         except subprocess.TimeoutExpired:
+            # kill BOTH, then reap each (collecting whatever it wrote) so
+            # no zombies/pipe fds outlive the test; signal via timed_out
             for p in procs:
                 p.kill()
-            raise AssertionError("distributed helper hung:\n" + "\n".join(outs))
-        return procs, outs
+            outs = []
+            for p in procs:
+                out, _ = p.communicate()
+                outs.append(out)
+            return procs, outs, True
+        return procs, outs, False
 
     def test_sharded_score_across_two_processes(self, tmp_path):
         import socket
 
         last = None
-        for _ in range(2):  # retry once: free-port discovery is racy
+        for _ in range(2):  # retry once: free-port discovery is racy,
+            # whether the collision surfaces as a fast bind failure or as
+            # a hang (a foreign listener accepting the coordinator dial)
             with socket.socket() as s:
                 s.bind(("localhost", 0))
                 port = s.getsockname()[1]
-            procs, outs = self._run_pair(port)
-            last = (procs, outs)
-            if all(p.returncode == 0 for p in procs):
+            procs, outs, timed_out = self._run_pair(port)
+            last = (procs, outs, timed_out)
+            if not timed_out and all(p.returncode == 0 for p in procs):
                 break
-        procs, outs = last
+        procs, outs, timed_out = last
+        assert not timed_out, "distributed helpers hung twice:\n" + "\n".join(outs)
         for i, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"proc {i} rc={p.returncode}:\n{out}"
             assert f"DIST_SCORE_OK pid={i}" in out, out

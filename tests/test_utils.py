@@ -1,0 +1,115 @@
+"""Unit tests for hyperopt_tpu.utils (reference parity: the reference's
+utils are exercised via its test_mongoexp/test_base suites; SURVEY.md §2
+#12 lists the helpers pinned here)."""
+
+import datetime
+import os
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import utils
+
+
+def test_import_tokens_module_chain():
+    objs = utils.import_tokens(["os", "path", "join"])
+    assert objs[-1] is os.path.join
+
+
+def test_json_call_dotted_path():
+    assert utils.json_call("math.hypot", (3, 4)) == 5.0
+    assert utils.json_call("os.path.join", ("a", "b")) == os.path.join("a", "b")
+
+
+def test_get_obj_variants():
+    assert utils.get_obj(dict, kwargs={"a": 1}) == {"a": 1}
+    sentinel = object()
+    assert utils.get_obj(None, obj=sentinel) is sentinel
+    assert utils.get_obj(None, cmd="collections.OrderedDict") == {}
+
+
+def test_coarse_utcnow_millisecond_floor():
+    t = utils.coarse_utcnow()
+    assert t.tzinfo is None
+    assert t.microsecond % 1000 == 0
+    # close to the real clock
+    now = datetime.datetime.now(datetime.timezone.utc).replace(tzinfo=None)
+    assert abs((now - t).total_seconds()) < 5.0
+
+
+def test_get_most_recent_inds():
+    docs = [
+        {"_id": 1, "version": 0},
+        {"_id": 1, "version": 1},
+        {"_id": 2, "version": 0},
+        {"_id": 3, "version": 2},
+        {"_id": 3, "version": 0},
+    ]
+    inds = sorted(utils.get_most_recent_inds(docs))
+    picked = [(docs[i]["_id"], docs[i]["version"]) for i in inds]
+    assert picked == [(1, 1), (2, 0), (3, 2)]
+
+
+def test_use_obj_for_literal_in_memo():
+    from hyperopt_tpu.base import Ctrl
+    from hyperopt_tpu.pyll.base import as_apply, Literal
+
+    lit = Literal(Ctrl)
+    expr = as_apply([lit, 2, 3])
+    handle = object()
+    memo = utils.use_obj_for_literal_in_memo(expr, handle, Ctrl, {})
+    assert memo[lit] is handle
+    assert len(memo) == 1  # only the sentinel literal is bound
+
+
+def test_pmin_sampled_probabilities():
+    # point 0 clearly lowest → wins almost always; columns sum to 1
+    p = utils.pmin_sampled([0.0, 5.0, 6.0], [1.0, 1.0, 1.0], n_samples=4000)
+    assert p.shape == (3,)
+    assert abs(p.sum() - 1.0) < 1e-9
+    assert p[0] > 0.95
+    # symmetric case splits evenly-ish
+    p = utils.pmin_sampled([1.0, 1.0], [1.0, 1.0], n_samples=8000)
+    assert abs(p[0] - 0.5) < 0.05
+
+
+def test_temp_dir_sentinel_lifecycle(tmp_path):
+    d = str(tmp_path / "w")
+    with utils.temp_dir(d) as got:
+        assert got == d
+        assert os.path.isdir(d)
+        assert os.path.exists(os.path.join(d, ".hyperopt_tpu_tmp"))
+    assert os.path.isdir(d)  # kept without erase_after
+    assert not os.path.exists(os.path.join(d, ".hyperopt_tpu_tmp"))
+
+
+def test_temp_dir_erase_after_only_if_created(tmp_path):
+    d = str(tmp_path / "mine")
+    with utils.temp_dir(d, erase_after=True):
+        assert os.path.isdir(d)
+    assert not os.path.exists(d)
+    # pre-existing dirs are never erased
+    pre = str(tmp_path / "pre")
+    os.makedirs(pre)
+    with utils.temp_dir(pre, erase_after=True):
+        pass
+    assert os.path.isdir(pre)
+
+
+def test_working_dir_restores_cwd(tmp_path):
+    before = os.getcwd()
+    with utils.working_dir(str(tmp_path)):
+        assert os.path.realpath(os.getcwd()) == os.path.realpath(str(tmp_path))
+    assert os.getcwd() == before
+    # restored even when the body raises
+    with pytest.raises(RuntimeError):
+        with utils.working_dir(str(tmp_path)):
+            raise RuntimeError
+    assert os.getcwd() == before
+
+
+def test_path_split_all():
+    assert utils.path_split_all(os.path.join("a", "b", "c")) == ["a", "b", "c"]
+    rooted = utils.path_split_all(os.sep + os.path.join("x", "y"))
+    assert rooted == [os.sep, "x", "y"]
+    assert utils.path_split_all("single") == ["single"]
