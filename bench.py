@@ -313,7 +313,9 @@ def _tpu_smoke():
     err = float(np.max(np.abs(got - ref)))
     if not np.isfinite(err) or err > 1e-2:
         raise RuntimeError(f"scorer precision smoke failed: max_err={err}")
-    return scorer, err
+    from hyperopt_tpu.ops import pallas_gmm
+
+    return scorer, err, pallas_gmm._fma_measured_default
 
 
 def _device_scorer_bench(rtt, cap_b, platform):
@@ -387,7 +389,7 @@ def main():
     domain, trials = build_history_trials()
     setup_s = time.time() - t_setup
 
-    smoke_scorer, smoke_err = _tpu_smoke()
+    smoke_scorer, smoke_err, smoke_fma = _tpu_smoke()
     rtt = _measure_rtt()
     cap_b = _derived_cap_b()
 
@@ -510,7 +512,7 @@ def main():
             if platform == "tpu"
             else None
         ),
-        "smoke": {"scorer": smoke_scorer, "precision_max_err": round(smoke_err, 6)},
+        "smoke": {"scorer": smoke_scorer, "precision_max_err": round(smoke_err, 6), "pallas_fma_default": smoke_fma},
         "scorer_ab": ab,
         "compile_warmup_s": round(warmup_s, 2),
         "setup_s": round(setup_s, 2),

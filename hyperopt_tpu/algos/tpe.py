@@ -252,24 +252,107 @@ def _pallas_probe() -> bool:
         return False
 
 
+def _fma_timing_probe(k_total=8192 + 32, n_cand=4096, iters=8):
+    """Time the Pallas kernel's two quadratic-evaluation modes (MXU dot
+    vs VPU FMA) once per process at a pallas-regime shape and set the
+    faster one as the process default (:func:`ops.pallas_gmm.set_default_fma`).
+
+    Timing is in-graph (a fori_loop chaining ``iters`` dependent kernel
+    calls, one scalar readback) so a network-tunneled chip's RTT doesn't
+    swamp millisecond kernel differences. Both modes share the identical
+    f32 contract, so whichever wins is purely a throughput choice.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import pallas_gmm
+
+    kb = 32
+    z = jnp.linspace(-2.0, 2.0, n_cand)
+    rngp = np.random.default_rng(0)
+    w = jnp.asarray(np.abs(rngp.normal(size=k_total)) + 0.1, jnp.float32)
+    from ..ops.score import pair_params
+
+    params = pair_params(
+        w[:kb] / jnp.sum(w[:kb]),
+        jnp.asarray(rngp.normal(size=kb), jnp.float32),
+        w[:kb] * 0 + 1.0,
+        w[kb:] / jnp.sum(w[kb:]),
+        jnp.asarray(rngp.normal(size=k_total - kb), jnp.float32),
+        w[kb:] * 0 + 1.0,
+    )
+
+    def timed(fma: bool) -> float:
+        @jax.jit
+        def chain(z0):
+            def body(_, c):
+                s = pallas_gmm.pair_score_pallas(
+                    z0 + c * jnp.float32(1e-7), params, kb, fma=fma
+                )
+                return s[0] * jnp.float32(1e-7)
+
+            return jax.lax.fori_loop(0, iters, body, jnp.float32(0.0))
+
+        jax.block_until_ready(chain(z))  # compile + warm
+        t0 = time.perf_counter()
+        jax.block_until_ready(chain(z))
+        return (time.perf_counter() - t0) / iters
+
+    t_mxu = timed(False)
+    t_fma = timed(True)
+    winner = t_fma < t_mxu
+    pallas_gmm.set_default_fma(winner)
+    logger.info(
+        "pallas kernel-mode probe: mxu %.3f ms, fma %.3f ms -> %s",
+        t_mxu * 1e3,
+        t_fma * 1e3,
+        "fma" if winner else "mxu",
+    )
+
+
 def _use_pallas():
     """Hand-tiled Pallas scorer on real TPUs; XLA/MXU formulation elsewhere.
 
     Probes the Pallas path once per process and demotes to "xla" if it
-    cannot lower.  Override with HYPEROPT_TPU_SCORER=pallas|xla|exact.
+    cannot lower; a second probe times the kernel's MXU-dot vs VPU-FMA
+    modes and keeps the faster (skip with HYPEROPT_TPU_FMA_PROBE=0, or
+    pin the mode with HYPEROPT_TPU_PALLAS_FMA).  Override the scorer
+    choice itself with HYPEROPT_TPU_SCORER=pallas|xla|exact.
     """
     import os
 
+    import jax
+
+    def maybe_probe_kernel_mode():
+        # once per process, on real TPUs only; the env pin wins outright
+        if (
+            jax.default_backend() == "tpu"
+            and os.environ.get("HYPEROPT_TPU_FMA_PROBE") != "0"
+            and os.environ.get("HYPEROPT_TPU_PALLAS_FMA") is None
+        ):
+            from ..ops import pallas_gmm
+
+            if pallas_gmm._fma_measured_default is None:
+                try:
+                    _fma_timing_probe()
+                except Exception as exc:  # pragma: no cover - TPU only
+                    logger.warning("pallas kernel-mode probe failed: %s", exc)
+
     forced = os.environ.get("HYPEROPT_TPU_SCORER")
     if forced:
+        if forced == "pallas":
+            maybe_probe_kernel_mode()
         return forced
-    import jax
 
     if jax.default_backend() != "tpu":
         return "xla"
     global _probed_scorer
     if _probed_scorer is None:
         _probed_scorer = "pallas" if _pallas_probe() else "xla"
+        if _probed_scorer == "pallas":
+            maybe_probe_kernel_mode()
     return _probed_scorer
 
 

@@ -160,16 +160,30 @@ def _features(z, c_pad: int):
     return f
 
 
+# process-wide measured default, set by the timing probe in
+# hyperopt_tpu.algos.tpe (None until a probe or set_default_fma call)
+_fma_measured_default = None
+
+
+def set_default_fma(value: bool) -> None:
+    """Set the process-wide kernel-mode default (used by the once-per-
+    process timing probe on real TPUs; the env var still wins)."""
+    global _fma_measured_default
+    _fma_measured_default = bool(value)
+
+
 def _default_fma() -> bool:
     """Kernel-body default for the quadratic evaluation: VPU FMA vs MXU
-    dot. Overridable per call (``fma=``) or process-wide via
-    ``HYPEROPT_TPU_PALLAS_FMA=0/1``; the shipped default is chosen by the
-    measured A/B in ``bench.py`` (``scorer_ab``)."""
+    dot. Resolution order: ``HYPEROPT_TPU_PALLAS_FMA=0/1`` env override,
+    then the process-wide measured default (:func:`set_default_fma`,
+    written by the TPU timing probe), then the MXU path."""
     import os
 
     v = os.environ.get("HYPEROPT_TPU_PALLAS_FMA")
     if v is not None:
         return v.strip().lower() in ("1", "true", "yes", "on")
+    if _fma_measured_default is not None:
+        return _fma_measured_default
     return False
 
 

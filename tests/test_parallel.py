@@ -426,22 +426,23 @@ class TestMultiProcessDistributed:
             )
             for i in range(2)
         ]
-        outs = []
+        outs = {}
         try:
-            for p in procs:
+            for i, p in enumerate(procs):
                 out, _ = p.communicate(timeout=240)
-                outs.append(out)
+                outs[i] = out
         except subprocess.TimeoutExpired:
-            # kill BOTH, then reap each (collecting whatever it wrote) so
-            # no zombies/pipe fds outlive the test; signal via timed_out
+            # kill BOTH, then reap the ones not yet communicated (keeping
+            # the finished process's output for the diagnostic) so no
+            # zombies/pipe fds outlive the test; signal via timed_out
             for p in procs:
                 p.kill()
-            outs = []
-            for p in procs:
-                out, _ = p.communicate()
-                outs.append(out)
-            return procs, outs, True
-        return procs, outs, False
+            for i, p in enumerate(procs):
+                if i not in outs:
+                    out, _ = p.communicate()
+                    outs[i] = out
+            return procs, [outs[i] for i in range(len(procs))], True
+        return procs, [outs[i] for i in range(len(procs))], False
 
     def test_sharded_score_across_two_processes(self, tmp_path):
         import socket

@@ -159,3 +159,29 @@ def test_pallas_fma_env_default(monkeypatch):
     assert pallas_gmm._default_fma() is True
     monkeypatch.setenv("HYPEROPT_TPU_PALLAS_FMA", "0")
     assert pallas_gmm._default_fma() is False
+
+
+def test_fma_measured_default_precedence(monkeypatch):
+    from hyperopt_tpu.ops import pallas_gmm
+
+    monkeypatch.delenv("HYPEROPT_TPU_PALLAS_FMA", raising=False)
+    monkeypatch.setattr(pallas_gmm, "_fma_measured_default", None)
+    assert pallas_gmm._default_fma() is False
+    pallas_gmm.set_default_fma(True)
+    assert pallas_gmm._default_fma() is True
+    # env override beats the measured default
+    monkeypatch.setenv("HYPEROPT_TPU_PALLAS_FMA", "0")
+    assert pallas_gmm._default_fma() is False
+    monkeypatch.setattr(pallas_gmm, "_fma_measured_default", None)
+
+
+def test_fma_probe_not_run_off_tpu(monkeypatch):
+    # off-TPU the scorer is xla and the timing probe must never fire
+    from hyperopt_tpu.algos import tpe
+
+    monkeypatch.delenv("HYPEROPT_TPU_SCORER", raising=False)
+    monkeypatch.setattr(tpe, "_probed_scorer", None)
+    called = []
+    monkeypatch.setattr(tpe, "_fma_timing_probe", lambda *a, **k: called.append(1))
+    assert tpe._use_pallas() == "xla"
+    assert not called

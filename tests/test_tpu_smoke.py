@@ -147,3 +147,18 @@ def test_pallas_fma_variant_lowers_and_matches_f64():
     got = np.asarray(pair_score_pallas(z, params, kb, fma=True))
     ref = _truth_pair_score(z, params, kb)
     np.testing.assert_allclose(got, ref, atol=1e-3)
+
+
+def test_fma_timing_probe_selects_a_mode():
+    # the once-per-process kernel-mode probe must run on hardware and
+    # leave a measured default behind; restore the prior value so the
+    # rest of the tier keeps its original (order-independent) default
+    from hyperopt_tpu.algos import tpe
+    from hyperopt_tpu.ops import pallas_gmm
+
+    prior = pallas_gmm._fma_measured_default
+    try:
+        tpe._fma_timing_probe(k_total=8192 + 32, n_cand=2048, iters=4)
+        assert pallas_gmm._fma_measured_default in (True, False)
+    finally:
+        pallas_gmm._fma_measured_default = prior
