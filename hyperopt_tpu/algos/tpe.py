@@ -216,6 +216,7 @@ def _host_label_keys(seed: int, n: int):
 
 
 _probed_scorer = None
+_fma_probe_attempted = False
 
 
 def _pallas_probe() -> bool:
@@ -252,11 +253,14 @@ def _pallas_probe() -> bool:
         return False
 
 
-def _fma_timing_probe(k_total=8192 + 32, n_cand=4096, iters=8):
+def _fma_timing_probe(k_total=8192 + 32, n_cand=2048, n_labels=4, iters=8):
     """Time the Pallas kernel's two quadratic-evaluation modes (MXU dot
-    vs VPU FMA) once per process at a pallas-regime shape and set the
-    faster one as the process default (:func:`ops.pallas_gmm.set_default_fma`).
+    vs VPU FMA) once per process and set the faster one as the process
+    default (:func:`ops.pallas_gmm.set_default_fma`).
 
+    The probed kernel is the label-stacked ``pair_score_pallas_batched``
+    — the production family path's (dominant) consumer, whose (L, n_c)
+    grid and per-label VMEM residency differ from the unbatched kernel.
     Timing is in-graph (a fori_loop chaining ``iters`` dependent kernel
     calls, one scalar readback) so a network-tunneled chip's RTT doesn't
     swamp millisecond kernel differences. Both modes share the identical
@@ -270,7 +274,7 @@ def _fma_timing_probe(k_total=8192 + 32, n_cand=4096, iters=8):
     from ..ops import pallas_gmm
 
     kb = 32
-    z = jnp.linspace(-2.0, 2.0, n_cand)
+    z = jnp.tile(jnp.linspace(-2.0, 2.0, n_cand), (n_labels, 1))
     rngp = np.random.default_rng(0)
     w = jnp.asarray(np.abs(rngp.normal(size=k_total)) + 0.1, jnp.float32)
     from ..ops.score import pair_params
@@ -283,15 +287,16 @@ def _fma_timing_probe(k_total=8192 + 32, n_cand=4096, iters=8):
         jnp.asarray(rngp.normal(size=k_total - kb), jnp.float32),
         w[kb:] * 0 + 1.0,
     )
+    params = jnp.tile(params[None], (n_labels, 1, 1))
 
     def timed(fma: bool) -> float:
         @jax.jit
         def chain(z0):
             def body(_, c):
-                s = pallas_gmm.pair_score_pallas(
+                s = pallas_gmm.pair_score_pallas_batched(
                     z0 + c * jnp.float32(1e-7), params, kb, fma=fma
                 )
-                return s[0] * jnp.float32(1e-7)
+                return s[0, 0] * jnp.float32(1e-7)
 
             return jax.lax.fori_loop(0, iters, body, jnp.float32(0.0))
 
@@ -305,7 +310,8 @@ def _fma_timing_probe(k_total=8192 + 32, n_cand=4096, iters=8):
     winner = t_fma < t_mxu
     pallas_gmm.set_default_fma(winner)
     logger.info(
-        "pallas kernel-mode probe: mxu %.3f ms, fma %.3f ms -> %s",
+        "pallas kernel-mode probe (batched kernel): mxu %.3f ms, fma "
+        "%.3f ms -> %s",
         t_mxu * 1e3,
         t_fma * 1e3,
         "fma" if winner else "mxu",
@@ -326,19 +332,23 @@ def _use_pallas():
     import jax
 
     def maybe_probe_kernel_mode():
-        # once per process, on real TPUs only; the env pin wins outright
+        # once per process, on real TPUs only; the env pin wins outright.
+        # _fma_probe_attempted (not the measured default) is the gate so a
+        # FAILING probe is never retried per suggest — a forced
+        # HYPEROPT_TPU_SCORER=pallas bypasses the _probed_scorer latch and
+        # would otherwise re-trace two 8-deep kernel chains on every call
+        global _fma_probe_attempted
         if (
-            jax.default_backend() == "tpu"
+            not _fma_probe_attempted
+            and jax.default_backend() == "tpu"
             and os.environ.get("HYPEROPT_TPU_FMA_PROBE") != "0"
             and os.environ.get("HYPEROPT_TPU_PALLAS_FMA") is None
         ):
-            from ..ops import pallas_gmm
-
-            if pallas_gmm._fma_measured_default is None:
-                try:
-                    _fma_timing_probe()
-                except Exception as exc:  # pragma: no cover - TPU only
-                    logger.warning("pallas kernel-mode probe failed: %s", exc)
+            _fma_probe_attempted = True
+            try:
+                _fma_timing_probe()
+            except Exception as exc:  # pragma: no cover - TPU only
+                logger.warning("pallas kernel-mode probe failed: %s", exc)
 
     forced = os.environ.get("HYPEROPT_TPU_SCORER")
     if forced:
