@@ -498,6 +498,30 @@ class Trials:
             return [r.get("status") for r in self.results]
         return [bandit.status(r, s) for r, s in zip(self.results, self.specs)]
 
+    def to_dataframe(self):
+        """Trial history as a pandas DataFrame: one row per trial with
+        tid/state/status/loss/book+refresh times plus one ``vals.<label>``
+        column per hyperparameter (NaN where the label's branch was
+        inactive). Beyond the reference (which leaves users to flatten
+        ``trials.trials`` by hand); import is deferred so pandas stays an
+        optional dependency."""
+        import pandas as pd
+
+        rows = []
+        for t in self.trials:
+            row = {
+                "tid": t["tid"],
+                "state": t["state"],
+                "status": t["result"].get("status"),
+                "loss": t["result"].get("loss"),
+                "book_time": t.get("book_time"),
+                "refresh_time": t.get("refresh_time"),
+            }
+            for label, vals in t["misc"]["vals"].items():
+                row[f"vals.{label}"] = vals[0] if vals else np.nan
+            rows.append(row)
+        return pd.DataFrame(rows)
+
     @property
     def best_trial(self):
         """The completed trial with the lowest loss (AllTrialsFailed if none)."""

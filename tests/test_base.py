@@ -363,3 +363,26 @@ class TestReviewRegressions:
         t.trials[1]["result"]["loss"] = 99.0
         t.refresh()
         assert list(t.history.losses) == [1.0, 99.0]
+
+
+def test_trials_to_dataframe():
+    import pandas as pd
+
+    from hyperopt_tpu import Trials, fmin, hp
+    from hyperopt_tpu.algos import rand
+
+    space = hp.choice("k", [{"t": "a", "u": hp.uniform("u", 0, 1)},
+                            {"t": "b", "v": hp.normal("v", 0, 1)}])
+    trials = Trials()
+    fmin(lambda c: c.get("u", 0.5) if c["t"] == "a" else abs(c["v"]),
+         space, algo=rand.suggest, max_evals=10, trials=trials,
+         rstate=np.random.default_rng(0), show_progressbar=False,
+         verbose=False, return_argmin=False)
+    df = trials.to_dataframe()
+    assert isinstance(df, pd.DataFrame)
+    assert len(df) == 10
+    assert {"tid", "state", "status", "loss", "vals.k", "vals.u", "vals.v"} <= set(df.columns)
+    # exactly one of u/v is active per row (conditional branches)
+    active = df[["vals.u", "vals.v"]].notna().sum(axis=1)
+    assert (active == 1).all()
+    assert df["loss"].notna().all()

@@ -87,6 +87,9 @@ def test_compiled_matches_interpreted_on_random_space(seed):
     space = _gen_space(rng, depth=2, counter=_counter())
 
     cs = CompiledSpace(space)
+    # vacuity guard: a CompileError silently degrades to the interpreted
+    # sampler, which would make this test compare it against itself
+    assert cs.compiled, getattr(cs, "compile_error", None)
     cvals, cact = cs.sample_batch(seed * 7 + 1, N_COMPILED)
     ivals, iact = CompiledSpace(space)._sample_interpreted(seed * 13 + 2, N_INTERP)
 
