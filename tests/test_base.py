@@ -386,3 +386,51 @@ def test_trials_to_dataframe():
     active = df[["vals.u", "vals.v"]].notna().sum(axis=1)
     assert (active == 1).all()
     assert df["loss"].notna().all()
+
+
+def test_pre_revision_pickle_resume_compat():
+    # Trials pickled before the revision counter existed must still
+    # refresh (trials_save_file resume path restores via pickle,
+    # skipping __init__)
+    import pickle
+
+    from hyperopt_tpu import Trials, fmin, hp
+    from hyperopt_tpu.algos import rand
+
+    t = Trials()
+    fmin(lambda c: c["x"] ** 2, {"x": hp.uniform("x", -1, 1)},
+         algo=rand.suggest, max_evals=5, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False,
+         verbose=False, return_argmin=False)
+    blob = pickle.dumps(t)
+    old = pickle.loads(blob)
+    del old.__dict__["_revision"]  # simulate a pre-revision checkpoint
+    old.refresh()
+    assert len(old.history.losses) == 5
+    old.refresh()
+    assert old._revision >= 2
+
+
+def test_history_cache_not_marked_fresh_after_failed_rebuild():
+    # an exception mid-rebuild (malformed loss) must leave the cache
+    # stale: the next access re-raises / recovers, never silently serves
+    # pre-mutation arrays
+    from hyperopt_tpu import Trials, fmin, hp
+    from hyperopt_tpu.algos import rand
+
+    t = Trials()
+    fmin(lambda c: c["x"] ** 2, {"x": hp.uniform("x", -1, 1)},
+         algo=rand.suggest, max_evals=4, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False,
+         verbose=False, return_argmin=False)
+    good_losses = list(t.history.losses)
+    bad = t.trials[1]
+    orig = bad["result"]["loss"]
+    bad["result"]["loss"] = [1.0, 2.0]  # not float-convertible
+    t._revision += 1  # mutation + sync point
+    with pytest.raises(TypeError):
+        t.history
+    # still stale (not silently fresh): repairing the doc recovers fully
+    bad["result"]["loss"] = orig
+    t._revision += 1
+    assert list(t.history.losses) == good_losses
