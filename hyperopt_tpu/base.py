@@ -221,9 +221,10 @@ class _TrialsHistory:
         self.losses = np.zeros(0, dtype=np.float64)
 
     def maybe_rebuild(self, trials_obj):
-        # Revision fast path: ``Trials`` bumps ``_revision`` at every
-        # documented mutation point (refresh / insert / delete_all), so
-        # an unchanged revision means the store content is unchanged and
+        # Revision fast path: ``Trials`` bumps ``_revision`` in
+        # ``refresh()`` — the sole point where ``_trials`` (what this
+        # cache reads) changes — so an unchanged revision means the
+        # store content is unchanged and
         # the O(N) fingerprint walk below is skipped entirely — this is
         # what keeps per-suggest host work O(1) at 10k-trial histories
         # (~27 ms/suggest of doc-walking otherwise, several times the
@@ -260,7 +261,6 @@ class _TrialsHistory:
         if fingerprint == self._fingerprint:
             self._seen_revision = rev
             return
-        self._fingerprint = fingerprint
 
         n_prev = len(self.loss_tids)
         append_only = (
@@ -271,21 +271,30 @@ class _TrialsHistory:
             # O(N) rebuild once any NaN enters the history
             and np.array_equal(fp_losses[:n_prev], self.losses, equal_nan=True)
         )
-        if not append_only:
-            self._idxs_lists = {}
-            self._vals_lists = {}
+        # Extend into COPIES and commit every attribute only after the
+        # walk finishes: an exception on a malformed doc (missing vals,
+        # bad loss) must leave the previous cache fully intact — a
+        # half-extended list plus a committed fingerprint would be served
+        # as fresh forever after.  The copies are pointer-shallow, ~50 µs
+        # at 10k trials, and only on actual content changes.
+        if append_only:
+            idxs_lists = {k: list(v) for k, v in self._idxs_lists.items()}
+            vals_lists = {k: list(v) for k, v in self._vals_lists.items()}
+        else:
+            idxs_lists, vals_lists = {}, {}
             n_prev = 0
         for t in kept[n_prev:]:
             for k, tt in t["misc"]["idxs"].items():
                 if tt:
-                    self._idxs_lists.setdefault(k, []).append(tt[0])
-                    self._vals_lists.setdefault(k, []).append(
-                        t["misc"]["vals"][k][0]
-                    )
+                    idxs_lists.setdefault(k, []).append(tt[0])
+                    vals_lists.setdefault(k, []).append(t["misc"]["vals"][k][0])
+        self._idxs_lists = idxs_lists
+        self._vals_lists = vals_lists
+        self._fingerprint = fingerprint
         self.loss_tids = fp_tids
         self.losses = fp_losses
-        self.idxs = {k: np.asarray(v, dtype=np.int64) for k, v in self._idxs_lists.items()}
-        self.vals = {k: np.asarray(v) for k, v in self._vals_lists.items()}
+        self.idxs = {k: np.asarray(v, dtype=np.int64) for k, v in idxs_lists.items()}
+        self.vals = {k: np.asarray(v) for k, v in vals_lists.items()}
         self._seen_revision = rev
 
 
@@ -387,10 +396,12 @@ class Trials:
 
     # -- store maintenance --------------------------------------------
     def refresh(self):
-        # every documented mutation path ends here; the bump is what lets
-        # _TrialsHistory skip its O(N) change scan between refreshes
-        # (getattr: Trials unpickled from pre-revision checkpoints lack
-        # the attribute — trials_save_file resume must keep working)
+        # refresh() is the SOLE revision-bump point: every documented
+        # mutation path ends here, and _trials (what the cache reads) only
+        # changes here.  The bump lets _TrialsHistory skip its O(N) change
+        # scan between refreshes.  (getattr: Trials unpickled from
+        # pre-revision checkpoints lack the attribute — trials_save_file
+        # resume must keep working)
         self._revision = getattr(self, "_revision", 0) + 1
         if self._exp_key is None:
             self._trials = [
@@ -431,7 +442,6 @@ class Trials:
     def _insert_trial_docs(self, docs):
         rval = [doc["tid"] for doc in docs]
         self._dynamic_trials.extend(docs)
-        self._revision = getattr(self, "_revision", 0) + 1
         return rval
 
     def insert_trial_doc(self, doc):
@@ -490,7 +500,6 @@ class Trials:
         self._dynamic_trials = []
         self.attachments = {}
         self._history = _TrialsHistory()
-        self._revision = getattr(self, "_revision", 0) + 1
         self.refresh()
 
     def count_by_state_synced(self, arg, trials=None):

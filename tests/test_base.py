@@ -434,3 +434,38 @@ def test_history_cache_not_marked_fresh_after_failed_rebuild():
     bad["result"]["loss"] = orig
     t._revision += 1
     assert list(t.history.losses) == good_losses
+
+
+def test_history_cache_atomic_under_malformed_misc():
+    # an exception in the SoA extension walk (doc with idxs but missing
+    # vals) must leave the PREVIOUS cache fully intact — no duplicated
+    # columns, no stale-served fingerprint
+    from hyperopt_tpu import Trials, fmin, hp
+    from hyperopt_tpu.algos import rand
+    from hyperopt_tpu.base import JOB_STATE_DONE, STATUS_OK
+
+    t = Trials()
+    fmin(lambda c: c["x"] ** 2, {"x": hp.uniform("x", -1, 1)},
+         algo=rand.suggest, max_evals=4, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False,
+         verbose=False, return_argmin=False)
+    good_vals = list(t.history.vals["x"])
+    # append a DONE doc whose misc is inconsistent: idxs present, vals empty
+    bad = {
+        "tid": 99, "spec": None,
+        "result": {"status": STATUS_OK, "loss": 0.5},
+        "misc": {"tid": 99, "cmd": None, "idxs": {"x": [99]}, "vals": {"x": []}},
+        "state": JOB_STATE_DONE, "owner": None,
+        "book_time": None, "refresh_time": None, "exp_key": None,
+    }
+    t._dynamic_trials.append(bad)
+    with pytest.raises(Exception):
+        t.refresh()
+    # repeated reads keep raising (never silently fresh) ...
+    with pytest.raises(Exception):
+        t.history
+    # ... and after removing the bad doc the cache is exactly the old one
+    t._dynamic_trials.remove(bad)
+    t.refresh()
+    assert list(t.history.vals["x"]) == good_vals
+    assert len(t.history.losses) == 4
