@@ -288,13 +288,18 @@ class _TrialsHistory:
                 if tt:
                     idxs_lists.setdefault(k, []).append(tt[0])
                     vals_lists.setdefault(k, []).append(t["misc"]["vals"][k][0])
+        # materialize BEFORE committing anything: np.asarray on a
+        # malformed column (e.g. a non-int tid) must not strand a
+        # committed fingerprint over misaligned arrays
+        idxs_arrays = {k: np.asarray(v, dtype=np.int64) for k, v in idxs_lists.items()}
+        vals_arrays = {k: np.asarray(v) for k, v in vals_lists.items()}
         self._idxs_lists = idxs_lists
         self._vals_lists = vals_lists
         self._fingerprint = fingerprint
         self.loss_tids = fp_tids
         self.losses = fp_losses
-        self.idxs = {k: np.asarray(v, dtype=np.int64) for k, v in idxs_lists.items()}
-        self.vals = {k: np.asarray(v) for k, v in vals_lists.items()}
+        self.idxs = idxs_arrays
+        self.vals = vals_arrays
         self._seen_revision = rev
 
 

@@ -469,3 +469,36 @@ def test_history_cache_atomic_under_malformed_misc():
     t.refresh()
     assert list(t.history.vals["x"]) == good_vals
     assert len(t.history.losses) == 4
+
+
+def test_history_cache_atomic_under_noncastable_tid():
+    # the FINAL materialization step (np.asarray of the idxs columns)
+    # must also be pre-commit: a non-int-castable tid may not strand a
+    # committed fingerprint over misaligned arrays
+    from hyperopt_tpu import Trials, fmin, hp
+    from hyperopt_tpu.algos import rand
+    from hyperopt_tpu.base import JOB_STATE_DONE, STATUS_OK
+
+    t = Trials()
+    fmin(lambda c: c["x"] ** 2, {"x": hp.uniform("x", -1, 1)},
+         algo=rand.suggest, max_evals=3, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False,
+         verbose=False, return_argmin=False)
+    good = list(t.history.vals["x"])
+    bad = {
+        "tid": 77, "spec": None,
+        "result": {"status": STATUS_OK, "loss": 0.1},
+        "misc": {"tid": 77, "cmd": None,
+                 "idxs": {"x": [None]}, "vals": {"x": [0.5]}},
+        "state": JOB_STATE_DONE, "owner": None,
+        "book_time": None, "refresh_time": None, "exp_key": None,
+    }
+    t._dynamic_trials.append(bad)
+    with pytest.raises(Exception):
+        t.refresh()
+    with pytest.raises(Exception):
+        t.history  # still raising, never silently misaligned
+    t._dynamic_trials.remove(bad)
+    t.refresh()
+    assert list(t.history.vals["x"]) == good
+    assert len(t.history.loss_tids) == len(t.history.idxs["x"]) == 3
