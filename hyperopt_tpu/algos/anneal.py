@@ -37,28 +37,15 @@ class AnnealingAlgo(SuggestAlgo):
         self.shrink_coef = shrink_coef
         hist = trials.history
         # per-label loss-sorted observations as (losses, tids, vals)
-        # numpy triples — all lookups/sorts vectorized (a python
-        # tuple-list build + sort here costs ~130 ms/suggest at a
-        # 10k-trial history, dominating the whole algorithm)
-        lt = np.asarray(hist.loss_tids, dtype=np.int64)
-        order = np.argsort(lt, kind="stable")
-        lt_sorted = lt[order]
-        losses_sorted = np.asarray(hist.losses, dtype=np.float64)[order]
+        # numpy triples — lookups via the cache's vectorized tid→loss
+        # join (a python tuple-list build + sort here costs ~130
+        # ms/suggest at a 10k-trial history, dominating the algorithm)
         self.observations = {}
         for label in self.specs:
             tids = np.asarray(hist.idxs.get(label, ()), dtype=np.int64)
             vals = np.asarray(hist.vals.get(label, ()))
-            if len(lt_sorted) and len(tids):
-                pos = np.clip(
-                    np.searchsorted(lt_sorted, tids), 0, len(lt_sorted) - 1
-                )
-                ok = lt_sorted[pos] == tids  # tids with an ok-loss only
-                tids, vals = tids[ok], vals[ok]
-                ls = losses_sorted[pos[ok]]
-            else:
-                tids = np.zeros(0, dtype=np.int64)
-                vals = vals[:0]
-                ls = np.zeros(0, dtype=np.float64)
+            ok, ls = hist.join_losses(tids)
+            tids, vals = tids[ok], vals[ok]
             srt = np.lexsort((tids, ls))  # by (loss, tid) — ref tiebreak
             self.observations[label] = (ls[srt], tids[srt], vals[srt])
 

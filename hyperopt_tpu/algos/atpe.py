@@ -227,22 +227,21 @@ class ATPEOptimizer:
         hp_feats = np.array([h.feature_vector() for h in hps.values()])
         n_params = len(hps)
 
-        # per-parameter |spearman-ish| correlation of value vs loss
-        loss_by_tid = dict(zip(hist.loss_tids.tolist(), losses.tolist()))
+        # per-parameter |spearman-ish| correlation of value vs loss via
+        # the cache's vectorized tid→loss join (the old per-pair python
+        # dict build cost ~100 ms/suggest at a 10k-trial history, AND
+        # misaligned every pair after the first NaN loss by zipping
+        # loss_tids against the NaN-filtered losses). Rank transforms
+        # make ±inf losses harmless, so only NaN pairs are dropped.
         corrs = []
         for lb in hps:
-            tids = hist.idxs.get(lb, [])
-            vals = hist.vals.get(lb, [])
-            pts = [
-                (float(v), loss_by_tid[int(t)])
-                for t, v in zip(tids, vals)
-                if int(t) in loss_by_tid
-                and np.isfinite(loss_by_tid[int(t)])
-            ]
-            if len(pts) < 5:
+            tids = np.asarray(hist.idxs.get(lb, ()), dtype=np.int64)
+            vals = np.asarray(hist.vals.get(lb, ()), dtype=float)
+            ok, l = hist.join_losses(tids)
+            v = vals[ok]
+            if len(v) < 5:
                 corrs.append(np.nan)  # sentinel: no evidence (≠ corr 0)
                 continue
-            v, l = np.array(pts).T
             vr = np.argsort(np.argsort(v)).astype(float)
             lr = np.argsort(np.argsort(l)).astype(float)
             denom = v.std() and (vr.std() * lr.std())

@@ -215,10 +215,35 @@ class _TrialsHistory:
         self._seen_revision = None
         self._idxs_lists = {}
         self._vals_lists = {}
+        self._loss_join_view = None
         self.idxs = {}
         self.vals = {}
         self.loss_tids = np.zeros(0, dtype=np.int64)
         self.losses = np.zeros(0, dtype=np.float64)
+
+    def __setstate__(self, state):
+        # defaults first, then the pickled attrs: caches pickled by older
+        # versions (inside trials_save_file checkpoints) lack newer
+        # attributes like _seen_revision/_loss_join_view
+        self.__init__()
+        self.__dict__.update(state)
+
+    def join_losses(self, tids):
+        """Vectorized tid→loss join against the aligned (loss_tids,
+        losses) arrays: returns ``(ok_mask, losses_of_ok)`` where
+        ``ok_mask`` marks tids present with a non-NaN loss.  The sorted
+        view is memoized per rebuild (shared by anneal's incumbent build
+        and ATPE's correlation featurizer — both per-suggest)."""
+        tids = np.asarray(tids, dtype=np.int64)
+        if self._loss_join_view is None:
+            order = np.argsort(self.loss_tids, kind="stable")
+            self._loss_join_view = (self.loss_tids[order], self.losses[order])
+        lt_sorted, ls_sorted = self._loss_join_view
+        if not len(lt_sorted) or not len(tids):
+            return np.zeros(len(tids), dtype=bool), np.zeros(0)
+        pos = np.clip(np.searchsorted(lt_sorted, tids), 0, len(lt_sorted) - 1)
+        ok = (lt_sorted[pos] == tids) & ~np.isnan(ls_sorted[pos])
+        return ok, ls_sorted[pos[ok]]
 
     def maybe_rebuild(self, trials_obj):
         # Revision fast path: ``Trials`` bumps ``_revision`` in
@@ -295,6 +320,7 @@ class _TrialsHistory:
         vals_arrays = {k: np.asarray(v) for k, v in vals_lists.items()}
         self._idxs_lists = idxs_lists
         self._vals_lists = vals_lists
+        self._loss_join_view = None  # re-memoized on next join_losses
         self._fingerprint = fingerprint
         self.loss_tids = fp_tids
         self.losses = fp_losses
@@ -567,19 +593,32 @@ class Trials:
 
     @property
     def best_trial(self):
-        """The completed trial with the lowest loss (AllTrialsFailed if none)."""
-        candidates = [
-            t
-            for t in self.trials
-            if t["result"].get("status") == STATUS_OK
-            and t["state"] == JOB_STATE_DONE
-            and t["result"].get("loss") is not None
-            and not np.isnan(float(t["result"]["loss"]))
-        ]
-        if not candidates:
+        """The completed trial with the lowest loss (AllTrialsFailed if none).
+
+        Rides the SoA history cache (DONE + ok + loss-not-None, aligned
+        tid/loss arrays) instead of re-walking every document — this is
+        called per suggest by ATPE's featurizer."""
+        self._history.maybe_rebuild(self)
+        ls = self._history.losses
+        usable = np.flatnonzero(~np.isnan(ls))  # -inf is a valid winner
+        if not len(usable):
             raise AllTrialsFailed
-        losses = [float(t["result"]["loss"]) for t in candidates]
-        return candidates[int(np.argmin(losses))]
+        # argmin over the usable subset, mapped back — an inf sentinel
+        # would tie with real +inf losses and could land on a NaN trial
+        best_tid = int(
+            self._history.loss_tids[usable[int(np.argmin(ls[usable]))]]
+        )
+        for t in self._trials:
+            # tid match alone could pick a shadowing non-completed doc if
+            # tids are ever duplicated — re-check the candidate filter
+            if (
+                t["tid"] == best_tid
+                and t["state"] == JOB_STATE_DONE
+                and t["result"].get("status") == STATUS_OK
+                and t["result"].get("loss") is not None
+            ):
+                return t
+        raise AllTrialsFailed  # cache/tid drift — should be unreachable
 
     @property
     def argmin(self):

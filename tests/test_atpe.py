@@ -338,3 +338,38 @@ class TestNaNLossRobustness:
             assert "unmeasured" not in locked
             hits += "weak" in locked
         assert hits > 10  # measured-weak still locks with high probability
+
+
+def test_corr_join_unaffected_by_nan_losses():
+    """Regression: the tid->loss join for per-parameter correlations must
+    stay ALIGNED when NaN (diverged) losses are present — the old
+    dict(zip(loss_tids, nan_filtered_losses)) shifted every pair after
+    the first NaN, silently corrupting all correlation features."""
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.algos.atpe import ATPEOptimizer
+    from hyperopt_tpu.base import JOB_STATE_DONE, STATUS_OK
+
+    space = {"x": hp.uniform("x", 0.0, 1.0)}
+    domain = Domain(lambda c: c["x"], space)
+    trials = Trials()
+    rng = np.random.default_rng(0)
+    docs = []
+    for i in range(40):
+        x = float(rng.uniform(0, 1))
+        # trial 3 diverges (NaN loss); all others: loss == x exactly
+        loss = float("nan") if i == 3 else x
+        docs.append({
+            "tid": i, "spec": None,
+            "result": {"status": STATUS_OK, "loss": loss},
+            "misc": {"tid": i, "cmd": None,
+                     "idxs": {"x": [i]}, "vals": {"x": [x]}},
+            "state": JOB_STATE_DONE, "owner": None,
+            "book_time": None, "refresh_time": None, "exp_key": None,
+        })
+    trials._insert_trial_docs(docs)
+    trials.refresh()
+    opt = ATPEOptimizer()
+    _, per_param = opt.compute_features(domain, trials)
+    # loss is literally the parameter value -> rank correlation must be
+    # exactly 1.0 on the 39 finite pairs; a shifted join scrambles it
+    assert per_param["x"] == pytest.approx(1.0, abs=1e-9)

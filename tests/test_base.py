@@ -405,8 +405,14 @@ def test_pre_revision_pickle_resume_compat():
     blob = pickle.dumps(t)
     old = pickle.loads(blob)
     del old.__dict__["_revision"]  # simulate a pre-revision checkpoint
+    # ... whose cache object also predates the newer attributes
+    for attr in ("_seen_revision", "_loss_join_view"):
+        old._history.__dict__.pop(attr, None)
+    old = pickle.loads(pickle.dumps(old))  # round-trip the stripped form
     old.refresh()
     assert len(old.history.losses) == 5
+    ok, ls = old.history.join_losses(old.history.loss_tids)
+    assert ok.all() and len(ls) == 5
     old.refresh()
     assert old._revision >= 2
 
