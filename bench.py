@@ -333,7 +333,10 @@ def _tpu_smoke():
         raise RuntimeError(f"scorer precision smoke failed: max_err={err}")
     from hyperopt_tpu.ops import pallas_gmm
 
-    return scorer, err, pallas_gmm._fma_measured_default
+    return scorer, err, (
+        pallas_gmm._fma_measured_default,
+        pallas_gmm._fma_measured_default_unbatched,
+    )
 
 
 def _device_scorer_bench(rtt, cap_b, platform):
@@ -535,7 +538,12 @@ def main():
             if platform == "tpu"
             else None
         ),
-        "smoke": {"scorer": smoke_scorer, "precision_max_err": round(smoke_err, 6), "pallas_fma_default": smoke_fma},
+        "smoke": {
+            "scorer": smoke_scorer,
+            "precision_max_err": round(smoke_err, 6),
+            "pallas_fma_default": smoke_fma[0],
+            "pallas_fma_default_unbatched": smoke_fma[1],
+        },
         "scorer_ab": ab,
         "compile_warmup_s": round(warmup_s, 2),
         "setup_s": round(setup_s, 2),

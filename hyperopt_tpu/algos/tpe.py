@@ -254,17 +254,19 @@ def _pallas_probe() -> bool:
 
 
 def _fma_timing_probe(k_total=8192 + 32, n_cand=2048, n_labels=4, iters=8):
-    """Time the Pallas kernel's two quadratic-evaluation modes (MXU dot
-    vs VPU FMA) once per process and set the faster one as the process
-    default (:func:`ops.pallas_gmm.set_default_fma`).
+    """Time the Pallas kernels' two quadratic-evaluation modes (MXU dot
+    vs VPU FMA) once per process and set the faster one as the per-kernel
+    process default (:func:`ops.pallas_gmm.set_default_fma`).
 
-    The probed kernel is the label-stacked ``pair_score_pallas_batched``
-    — the production family path's (dominant) consumer, whose (L, n_c)
-    grid and per-label VMEM residency differ from the unbatched kernel.
-    Timing is in-graph (a fori_loop chaining ``iters`` dependent kernel
-    calls, one scalar readback) so a network-tunneled chip's RTT doesn't
-    swamp millisecond kernel differences. Both modes share the identical
-    f32 contract, so whichever wins is purely a throughput choice.
+    BOTH kernels are probed independently: the label-stacked
+    ``pair_score_pallas_batched`` (the production family path's dominant
+    consumer) and the unbatched ``pair_score_pallas`` (the sharded/legacy
+    path) — their grids and VMEM residency differ, so the faster mode can
+    differ between them (ADVICE r4).  Timing is in-graph (a fori_loop
+    chaining ``iters`` dependent kernel calls, one scalar readback) so a
+    network-tunneled chip's RTT doesn't swamp millisecond kernel
+    differences. Both modes share the identical f32 contract, so
+    whichever wins is purely a throughput choice.
     """
     import time
 
@@ -289,14 +291,19 @@ def _fma_timing_probe(k_total=8192 + 32, n_cand=2048, n_labels=4, iters=8):
     )
     params = jnp.tile(params[None], (n_labels, 1, 1))
 
-    def timed(fma: bool) -> float:
+    def timed(fma: bool, batched: bool) -> float:
         @jax.jit
         def chain(z0):
             def body(_, c):
-                s = pallas_gmm.pair_score_pallas_batched(
-                    z0 + c * jnp.float32(1e-7), params, kb, fma=fma
+                if batched:
+                    s = pallas_gmm.pair_score_pallas_batched(
+                        z0 + c * jnp.float32(1e-7), params, kb, fma=fma
+                    )
+                    return s[0, 0] * jnp.float32(1e-7)
+                s = pallas_gmm.pair_score_pallas(
+                    z0[0] + c * jnp.float32(1e-7), params[0], kb, fma=fma
                 )
-                return s[0, 0] * jnp.float32(1e-7)
+                return s[0] * jnp.float32(1e-7)
 
             return jax.lax.fori_loop(0, iters, body, jnp.float32(0.0))
 
@@ -305,17 +312,19 @@ def _fma_timing_probe(k_total=8192 + 32, n_cand=2048, n_labels=4, iters=8):
         jax.block_until_ready(chain(z))
         return (time.perf_counter() - t0) / iters
 
-    t_mxu = timed(False)
-    t_fma = timed(True)
-    winner = t_fma < t_mxu
-    pallas_gmm.set_default_fma(winner)
-    logger.info(
-        "pallas kernel-mode probe (batched kernel): mxu %.3f ms, fma "
-        "%.3f ms -> %s",
-        t_mxu * 1e3,
-        t_fma * 1e3,
-        "fma" if winner else "mxu",
-    )
+    for kernel, batched in (("batched", True), ("unbatched", False)):
+        t_mxu = timed(False, batched)
+        t_fma = timed(True, batched)
+        winner = t_fma < t_mxu
+        pallas_gmm.set_default_fma(winner, kernel=kernel)
+        logger.info(
+            "pallas kernel-mode probe (%s kernel): mxu %.3f ms, fma "
+            "%.3f ms -> %s",
+            kernel,
+            t_mxu * 1e3,
+            t_fma * 1e3,
+            "fma" if winner else "mxu",
+        )
 
 
 def _use_pallas():

@@ -169,8 +169,10 @@ class DeviceHistory:
         self.capt = 0
         self.losses = None  # [CAPT] f32 device, padded +BIG
         self._n_synced = 0
-        self._loss_tids = np.zeros(0, np.int64)  # host copies for append check
+        self._loss_tids = np.zeros(0, np.int64)  # synced snapshot for append check
         self._losses_synced = np.zeros(0, np.float64)
+        self._seen_content_version = None
+        self._synced_hist = lambda: None  # weakref to the last-synced hist
         self._tid_row = {}
         # instrumentation (read by bench.py): host->device traffic
         self.sync_time = 0.0
@@ -194,20 +196,47 @@ class DeviceHistory:
     def sync(self, hist):
         t0 = time.perf_counter()
         n = len(hist.losses)
-        appended = (
-            n >= self._n_synced
-            and np.array_equal(hist.loss_tids[: self._n_synced], self._loss_tids)
-            # losses too: an in-place result mutation keeps the tid prefix
-            # but must invalidate the device copy (equal_nan: NaN losses
-            # are legitimate diverged trials, not changes)
-            and np.array_equal(
-                hist.losses[: self._n_synced], self._losses_synced, equal_nan=True
+        # O(1) steady state: _TrialsHistory bumps ``content_version`` on
+        # every array commit and records the last NON-append-only commit
+        # in ``last_nonappend_version``.  If nothing committed since our
+        # last sync, return; if only append-only commits happened, take
+        # the append path without touching the synced prefix.  The O(N)
+        # prefix comparison survives solely as the fallback for histories
+        # lacking the counters (old pickled caches) or after a
+        # non-append rebuild (where it can still salvage an append).
+        # Version counters are only comparable within ONE hist object —
+        # Trials can swap in a fresh _TrialsHistory (delete_all, unpickle)
+        # whose counter restarts at 0, so both fast paths require identity.
+        same_hist = self._synced_hist() is hist
+        ver = getattr(hist, "content_version", None)
+        if ver is not None and same_hist and ver == self._seen_content_version:
+            self.sync_time += time.perf_counter() - t0
+            return
+        if (
+            ver is not None
+            and same_hist
+            and self._seen_content_version is not None
+            and hist.last_nonappend_version <= self._seen_content_version
+            and n >= self._n_synced
+        ):
+            appended = True
+        else:
+            appended = (
+                n >= self._n_synced
+                and np.array_equal(hist.loss_tids[: self._n_synced], self._loss_tids)
+                # losses too: an in-place result mutation keeps the tid
+                # prefix but must invalidate the device copy (equal_nan:
+                # NaN losses are legitimate diverged trials, not changes)
+                and np.array_equal(
+                    hist.losses[: self._n_synced], self._losses_synced, equal_nan=True
+                )
             )
-        )
         if not appended:
             self._rebuild(hist)
         elif n > self._n_synced:
             self._append(hist)
+        self._seen_content_version = ver
+        self._synced_hist = weakref.ref(hist)
         self.sync_time += time.perf_counter() - t0
 
     def _upload(self, arr):
@@ -223,8 +252,11 @@ class DeviceHistory:
         buf = np.full(self.capt, _BIG, np.float32)
         buf[:n] = hist.losses
         self.losses = self._upload(buf)
-        self._loss_tids = np.array(hist.loss_tids, np.int64)
-        self._losses_synced = np.array(hist.losses, np.float64)
+        # references, not copies: _TrialsHistory commits fresh arrays on
+        # every content change and never mutates them in place, so the
+        # snapshot semantics hold without an O(N) host copy per sync
+        self._loss_tids = hist.loss_tids
+        self._losses_synced = hist.losses
         self._tid_row = {int(t): i for i, t in enumerate(self._loss_tids)}
         self._n_synced = n
 
@@ -269,8 +301,8 @@ class DeviceHistory:
         self.bytes_uploaded += idx.nbytes + lvals.nbytes
         for i, t in enumerate(hist.loss_tids[old_n:]):
             self._tid_row[int(t)] = old_n + i
-        self._loss_tids = np.array(hist.loss_tids, np.int64)
-        self._losses_synced = np.array(hist.losses, np.float64)
+        self._loss_tids = hist.loss_tids  # fresh array per commit; see _rebuild
+        self._losses_synced = hist.losses
         self._n_synced = n
 
         changed, fam_deltas = [], []

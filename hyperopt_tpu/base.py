@@ -220,6 +220,14 @@ class _TrialsHistory:
         self.vals = {}
         self.loss_tids = np.zeros(0, dtype=np.int64)
         self.losses = np.zeros(0, dtype=np.float64)
+        # Monotonic content version: bumped each time the arrays are
+        # actually replaced.  ``last_nonappend_version`` marks the last
+        # bump that was NOT append-only growth — downstream device
+        # mirrors (tpe_device.DeviceHistory) use the pair to take their
+        # append fast path without re-comparing the full synced prefix
+        # (O(N) per suggest otherwise).
+        self.content_version = 0
+        self.last_nonappend_version = 0
 
     def __setstate__(self, state):
         # defaults first, then the pickled attrs: caches pickled by older
@@ -326,6 +334,9 @@ class _TrialsHistory:
         self.losses = fp_losses
         self.idxs = idxs_arrays
         self.vals = vals_arrays
+        self.content_version += 1
+        if not append_only:
+            self.last_nonappend_version = self.content_version
         self._seen_revision = rev
 
 
@@ -335,6 +346,16 @@ class Trials:
     Document format is the reference's: ``tid``, ``spec``, ``result``,
     ``misc`` (with sparse per-label ``idxs``/``vals``), ``state``, ``owner``,
     ``book_time``, ``refresh_time``, ``exp_key``.
+
+    **Mutation contract (refresh-before-read):** every mutation of trial
+    documents must be followed by :meth:`refresh` before ``history`` /
+    ``best_trial`` / the suggest algorithms read the store.  ``refresh``
+    is the sole revision-bump point; the SoA history cache and the
+    device-resident mirrors key their O(1) fast paths off that revision,
+    so in-place doc edits without a refresh are invisible to them.
+    Subclasses overriding ``refresh`` must call ``super().refresh()``
+    (or otherwise reach the bump) — pinned by
+    ``tests/test_device_history.py::TestRevisionContract``.
     """
 
     asynchronous = False

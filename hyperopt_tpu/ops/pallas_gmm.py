@@ -160,30 +160,42 @@ def _features(z, c_pad: int):
     return f
 
 
-# process-wide measured default, set by the timing probe in
-# hyperopt_tpu.algos.tpe (None until a probe or set_default_fma call)
-_fma_measured_default = None
+# process-wide measured defaults, set by the timing probe in
+# hyperopt_tpu.algos.tpe (None until a probe or set_default_fma call).
+# Kept PER KERNEL: the batched kernel's (L, n_c) grid and per-label VMEM
+# residency differ from the unbatched kernel's, so the faster mode can
+# legitimately differ between them (ADVICE r4 tpe.py:256).
+_fma_measured_default = None  # pair_score_pallas_batched
+_fma_measured_default_unbatched = None  # pair_score_pallas
 
 
-def set_default_fma(value: bool) -> None:
-    """Set the process-wide kernel-mode default (used by the once-per-
-    process timing probe on real TPUs; the env var still wins)."""
-    global _fma_measured_default
-    _fma_measured_default = bool(value)
+def set_default_fma(value: bool, kernel: str = "both") -> None:
+    """Set the process-wide kernel-mode default for ``kernel`` in
+    ``{"batched", "unbatched", "both"}`` (used by the once-per-process
+    timing probe on real TPUs; the env var still wins)."""
+    global _fma_measured_default, _fma_measured_default_unbatched
+    v = bool(value)
+    if kernel not in ("batched", "unbatched", "both"):
+        raise ValueError(kernel)
+    if kernel in ("batched", "both"):
+        _fma_measured_default = v
+    if kernel in ("unbatched", "both"):
+        _fma_measured_default_unbatched = v
 
 
-def _default_fma() -> bool:
+def _default_fma(batched: bool = True) -> bool:
     """Kernel-body default for the quadratic evaluation: VPU FMA vs MXU
     dot. Resolution order: ``HYPEROPT_TPU_PALLAS_FMA=0/1`` env override,
-    then the process-wide measured default (:func:`set_default_fma`,
+    then the per-kernel measured default (:func:`set_default_fma`,
     written by the TPU timing probe), then the MXU path."""
     import os
 
     v = os.environ.get("HYPEROPT_TPU_PALLAS_FMA")
     if v is not None:
         return v.strip().lower() in ("1", "true", "yes", "on")
-    if _fma_measured_default is not None:
-        return _fma_measured_default
+    measured = _fma_measured_default if batched else _fma_measured_default_unbatched
+    if measured is not None:
+        return measured
     return False
 
 
@@ -198,7 +210,7 @@ def pair_score_pallas(
     ``HYPEROPT_TPU_PALLAS_FMA`` mid-process takes effect on the next call
     (the resolved bool is the static cache key, never ``None``)."""
     if fma is None:
-        fma = _default_fma()
+        fma = _default_fma(batched=False)
     return _pair_score_pallas(z, params_pair, k_below, tc, tk, interpret, fma)
 
 

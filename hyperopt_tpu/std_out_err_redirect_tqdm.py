@@ -4,6 +4,7 @@ Reference parity (SURVEY.md §2 #20): ``hyperopt/std_out_err_redirect_tqdm.py``.
 """
 
 import contextlib
+import io
 import sys
 
 from tqdm import tqdm
@@ -35,7 +36,12 @@ class DummyTqdmFile:
         return getattr(self.file, "isatty", lambda: False)()
 
     def fileno(self):
-        return self.file.fileno()
+        # file-like contract: absence of a fileno is signalled with
+        # io.UnsupportedOperation (an OSError), not AttributeError
+        fn = getattr(self.file, "fileno", None)
+        if fn is None:
+            raise io.UnsupportedOperation("fileno")
+        return fn()
 
 
 @contextlib.contextmanager

@@ -1,0 +1,48 @@
+#!/bin/bash
+# TPU-artifact watcher (VERDICT r4 #1): the axon tunnel dies for hours at
+# a time, so this loops probing it and, the moment a real chip answers,
+# runs the full bench on hardware and saves committed-quality artifacts:
+#   BENCH_TPU.json       - headline config (10k history, pallas/fma A/B)
+#   BENCH_TPU_100k.json  - 100k-history host-transfer flatness point
+# Exits once BENCH_TPU.json has "platform": "tpu".
+cd /root/repo || exit 1
+
+have_tpu_artifact() {
+  [ -s "$1" ] && python -c "import json,sys; d=json.load(open('$1')); sys.exit(0 if d.get('platform')=='tpu' else 1)" 2>/dev/null
+}
+
+while true; do
+  if have_tpu_artifact BENCH_TPU.json && have_tpu_artifact BENCH_TPU_100k.json; then
+    echo "$(date -u +%FT%TZ) both TPU artifacts present; watcher done"
+    break
+  fi
+  if timeout 180 python -c "import jax; assert jax.devices()[0].platform=='tpu'" >/dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) tunnel ALIVE"
+    if ! have_tpu_artifact BENCH_TPU.json; then
+      echo "$(date -u +%FT%TZ) running headline bench..."
+      if timeout 3600 python bench.py >/tmp/bench_tpu_out.json 2>/tmp/bench_tpu_err.log \
+         && have_tpu_artifact /tmp/bench_tpu_out.json; then
+        cp /tmp/bench_tpu_out.json BENCH_TPU.json
+        echo "$(date -u +%FT%TZ) captured BENCH_TPU.json"
+      else
+        echo "$(date -u +%FT%TZ) headline bench failed/CPU; stderr tail:"
+        tail -5 /tmp/bench_tpu_err.log
+      fi
+    fi
+    if have_tpu_artifact BENCH_TPU.json && ! have_tpu_artifact BENCH_TPU_100k.json; then
+      echo "$(date -u +%FT%TZ) running 100k-history bench (AB off)..."
+      if BENCH_N_HISTORY=100000 BENCH_AB=0 BENCH_TIMED=15 \
+         timeout 3600 python bench.py >/tmp/bench_tpu100k_out.json 2>/tmp/bench_tpu100k_err.log \
+         && have_tpu_artifact /tmp/bench_tpu100k_out.json; then
+        cp /tmp/bench_tpu100k_out.json BENCH_TPU_100k.json
+        echo "$(date -u +%FT%TZ) captured BENCH_TPU_100k.json"
+      else
+        echo "$(date -u +%FT%TZ) 100k bench failed/CPU; stderr tail:"
+        tail -5 /tmp/bench_tpu100k_err.log
+      fi
+    fi
+  else
+    echo "$(date -u +%FT%TZ) tunnel dead"
+  fi
+  sleep 240
+done

@@ -81,3 +81,37 @@ def test_anneal_respects_bounds():
     ys = [m["vals"]["y"][0] for m in trials.miscs]
     assert min(xs) >= -5.0 and max(xs) <= 10.0
     assert min(ys) >= 0.0 and max(ys) <= 15.0
+
+
+def test_anneal_drops_nan_loss_trials():
+    """A NaN-loss (diverged) trial must be excluded from the per-label
+    observations — and shrink T with it — rather than occupying an
+    arbitrary sort position (ADVICE r4 anneal.py:50)."""
+    from hyperopt_tpu.base import JOB_STATE_DONE, STATUS_OK
+
+    d = domains.get("quadratic1")
+    domain = Domain(d.fn, d.space)
+    trials = Trials()
+    docs = []
+    for i in range(6):
+        loss = float("nan") if i == 2 else float(i)
+        docs.append({
+            "tid": i, "spec": None,
+            "result": {"status": STATUS_OK, "loss": loss},
+            "misc": {"tid": i, "cmd": None,
+                     "idxs": {"x": [i]}, "vals": {"x": [float(i)]}},
+            "state": JOB_STATE_DONE, "owner": None,
+            "book_time": None, "refresh_time": None, "exp_key": None,
+        })
+    trials._insert_trial_docs(docs)
+    trials.refresh()
+
+    algo = anneal.AnnealingAlgo(domain, trials, seed=0)
+    ls, tids, vals = algo.observations["x"]
+    assert len(ls) == 5  # the NaN trial is gone
+    assert 2 not in tids
+    assert not np.isnan(ls).any()
+    assert algo.shrinking("x") == 1.0 / (1.0 + 5 * algo.shrink_coef)
+    # and suggest still works end to end
+    out = anneal.suggest([100], domain, trials, seed=1)
+    assert np.isfinite(out[0]["misc"]["vals"]["x"][0])

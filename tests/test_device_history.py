@@ -1,0 +1,177 @@
+"""DeviceHistory.sync steady-state contract (VERDICT r4 #6).
+
+The per-suggest steady state must be O(k-appended), not O(N-history):
+``_TrialsHistory`` exports (content_version, last_nonappend_version) and
+``DeviceHistory.sync`` keys its append fast path off them, so the O(N)
+prefix comparison only runs as a fallback.  These tests pin:
+
+- append-only growth never triggers a device rebuild;
+- the fast paths genuinely skip the O(N) compare (np.array_equal is
+  poisoned and must not be called);
+- correctness survives the shortcuts: in-place loss mutation after a
+  refresh() still rebuilds, and a swapped-in fresh ``_TrialsHistory``
+  (whose counters restart) cannot be mistaken for an append;
+- the refresh-before-read revision contract holds for subclasses that
+  override ``refresh`` (ADVICE r4 base.py:261).
+"""
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, hp
+from hyperopt_tpu.base import JOB_STATE_DONE, STATUS_OK, Domain, _TrialsHistory
+from hyperopt_tpu.algos import tpe_device
+
+
+def _doc(tid, x, loss):
+    return {
+        "tid": tid,
+        "spec": None,
+        "result": {"status": STATUS_OK, "loss": float(loss)},
+        "misc": {
+            "tid": tid,
+            "cmd": None,
+            "idxs": {"x": [tid]},
+            "vals": {"x": [float(x)]},
+        },
+        "state": JOB_STATE_DONE,
+        "owner": None,
+        "book_time": None,
+        "refresh_time": None,
+        "exp_key": None,
+    }
+
+
+def _setup(n=10):  # bucket(10)=16: appends below 16 stay incremental
+    rng = np.random.default_rng(0)
+    trials = Trials()
+    trials._insert_trial_docs([_doc(i, rng.uniform(-1, 1), rng.normal()) for i in range(n)])
+    trials.refresh()
+    domain = Domain(lambda c: 0.0, {"x": hp.uniform("x", -1, 1)})
+    dh = tpe_device.device_history_for(trials, domain.space)
+    dh.sync(trials.history)
+    return trials, domain, dh
+
+
+def _append(trials, tid, x=0.5, loss=0.1):
+    trials._insert_trial_docs([_doc(tid, x, loss)])
+    trials.refresh()
+
+
+class TestSyncFastPath:
+    def test_appends_never_rebuild(self):
+        # n=10 -> capacity bucket 16: appends up to 16 must take the
+        # incremental path (rebuilds happen only on bucket growth)
+        trials, _, dh = _setup(n=10)
+        assert dh.full_rebuilds == 1
+        for tid in range(10, 16):
+            _append(trials, tid)
+            dh.sync(trials.history)
+        assert dh.full_rebuilds == 1
+        assert dh._n_synced == 16
+
+    def test_append_skips_prefix_compare(self, monkeypatch):
+        """The version fast path must not touch np.array_equal — that
+        comparison is the O(N) host term VERDICT r4 #6 bans from the
+        steady state."""
+        trials, _, dh = _setup()
+        _append(trials, 10)
+        hist = trials.history  # maybe_rebuild BEFORE poisoning
+
+        def boom(*a, **k):  # pragma: no cover - failure path
+            raise AssertionError("O(N) prefix compare ran in steady state")
+
+        monkeypatch.setattr(tpe_device.np, "array_equal", boom)
+        dh.sync(hist)
+        assert dh._n_synced == 11
+        assert dh.full_rebuilds == 1
+
+    def test_noop_sync_skips_everything(self, monkeypatch):
+        trials, _, dh = _setup()
+        hist = trials.history
+        bytes0 = dh.bytes_uploaded
+
+        def boom(*a, **k):  # pragma: no cover - failure path
+            raise AssertionError("compare ran on an unchanged history")
+
+        monkeypatch.setattr(tpe_device.np, "array_equal", boom)
+        for _ in range(3):
+            dh.sync(hist)
+        assert dh.bytes_uploaded == bytes0
+
+    def test_inplace_mutation_after_refresh_rebuilds(self):
+        """Changing a completed loss (not an append) must invalidate the
+        device copy — the version counters mark it non-append."""
+        trials, _, dh = _setup()
+        trials._dynamic_trials[3]["result"]["loss"] = 123.0
+        trials.refresh()
+        dh.sync(trials.history)
+        assert dh.full_rebuilds == 2
+        row = dh._tid_row[3]
+        assert float(np.asarray(dh.losses)[row]) == pytest.approx(123.0)
+
+    def test_fresh_history_object_not_mistaken_for_append(self):
+        """Counters restart when Trials swaps in a new _TrialsHistory;
+        identity gating must force the fallback compare (which here
+        still detects a clean rebuild is needed)."""
+        trials, _, dh = _setup()
+        ver_before = trials.history.content_version
+        trials._history = _TrialsHistory()
+        # shrink the store so a bogus append would read garbage
+        trials._dynamic_trials = trials._dynamic_trials[:3]
+        trials.refresh()
+        assert trials.history.content_version <= ver_before  # restarted
+        dh.sync(trials.history)
+        assert dh.full_rebuilds == 2
+        assert dh._n_synced == 3
+
+    def test_sync_keeps_math_aligned(self):
+        """End-to-end: after interleaved appends the device buffers match
+        a from-scratch rebuild exactly."""
+        trials, domain, dh = _setup()
+        rng = np.random.default_rng(1)
+        for tid in range(10, 22):
+            _append(trials, tid, rng.uniform(-1, 1), rng.normal())
+            dh.sync(trials.history)
+        fresh = tpe_device.DeviceHistory(domain.space.specs)
+        fresh.sync(trials.history)
+        np.testing.assert_array_equal(
+            np.asarray(dh.losses)[: dh._n_synced],
+            np.asarray(fresh.losses)[: fresh._n_synced],
+        )
+        fam = next(iter(dh.families.values()))
+        ffam = next(iter(fresh.families.values()))
+        np.testing.assert_array_equal(np.asarray(fam.counts), np.asarray(ffam.counts))
+        c = int(np.asarray(fam.counts)[0])
+        np.testing.assert_array_equal(
+            np.asarray(fam.obs)[0, :c], np.asarray(ffam.obs)[0, :c]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(fam.pos)[0, :c], np.asarray(ffam.pos)[0, :c]
+        )
+
+
+class TestRevisionContract:
+    def test_subclass_override_still_bumps_revision(self):
+        """ADVICE r4: a Trials subclass overriding refresh() must reach
+        the revision bump (the documented refresh-before-read contract)."""
+
+        class MyTrials(Trials):
+            def refresh(self):
+                self.custom_hook = True
+                super().refresh()
+
+        t = MyTrials()
+        r0 = t._revision
+        t._insert_trial_docs([_doc(0, 0.1, 0.2)])
+        t.refresh()
+        assert t._revision > r0
+        assert len(t.history.losses) == 1
+
+    def test_file_trials_refresh_bumps_revision(self, tmp_path):
+        from hyperopt_tpu.parallel.file_trials import FileTrials
+
+        t = FileTrials(str(tmp_path))
+        r0 = t._revision
+        t.refresh()
+        assert t._revision > r0

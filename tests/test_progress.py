@@ -64,3 +64,19 @@ def test_fmin_invokes_custom_progress_callback(monkeypatch):
     assert calls["total"] == 7
     assert sum(calls["updates"]) == 7
     assert calls["postfix"], "best-loss postfix never set"
+
+
+def test_dummy_tqdm_file_fileno_contract():
+    """fileno() mirrors the other methods' defensiveness: real fd when the
+    wrapped object has one, io.UnsupportedOperation (not AttributeError)
+    when it doesn't (ADVICE r4)."""
+    import io
+    import sys
+
+    import pytest
+
+    from hyperopt_tpu.std_out_err_redirect_tqdm import DummyTqdmFile
+
+    assert DummyTqdmFile(sys.__stdout__).fileno() == sys.__stdout__.fileno()
+    with pytest.raises(io.UnsupportedOperation):
+        DummyTqdmFile(object()).fileno()

@@ -165,14 +165,23 @@ def test_fma_measured_default_precedence(monkeypatch):
     from hyperopt_tpu.ops import pallas_gmm
 
     monkeypatch.delenv("HYPEROPT_TPU_PALLAS_FMA", raising=False)
+    # monkeypatch BOTH globals first so set_default_fma's writes are
+    # rolled back at teardown (kernel="both" touches the unbatched one)
     monkeypatch.setattr(pallas_gmm, "_fma_measured_default", None)
+    monkeypatch.setattr(pallas_gmm, "_fma_measured_default_unbatched", None)
     assert pallas_gmm._default_fma() is False
+    assert pallas_gmm._default_fma(batched=False) is False
     pallas_gmm.set_default_fma(True)
     assert pallas_gmm._default_fma() is True
+    assert pallas_gmm._default_fma(batched=False) is True
+    # per-kernel defaults are independent
+    pallas_gmm.set_default_fma(False, kernel="unbatched")
+    assert pallas_gmm._default_fma() is True
+    assert pallas_gmm._default_fma(batched=False) is False
     # env override beats the measured default
     monkeypatch.setenv("HYPEROPT_TPU_PALLAS_FMA", "0")
     assert pallas_gmm._default_fma() is False
-    monkeypatch.setattr(pallas_gmm, "_fma_measured_default", None)
+    assert pallas_gmm._default_fma(batched=False) is False
 
 
 def test_fma_probe_not_run_off_tpu(monkeypatch):

@@ -157,8 +157,11 @@ def test_fma_timing_probe_selects_a_mode():
     from hyperopt_tpu.ops import pallas_gmm
 
     prior = pallas_gmm._fma_measured_default
+    prior_ub = pallas_gmm._fma_measured_default_unbatched
     try:
         tpe._fma_timing_probe(k_total=8192 + 32, n_cand=2048, iters=4)
         assert pallas_gmm._fma_measured_default in (True, False)
+        assert pallas_gmm._fma_measured_default_unbatched in (True, False)
     finally:
         pallas_gmm._fma_measured_default = prior
+        pallas_gmm._fma_measured_default_unbatched = prior_ub
