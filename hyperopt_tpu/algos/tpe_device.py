@@ -149,7 +149,18 @@ class DeviceHistory:
     uploads only the delta.
     """
 
-    def __init__(self, specs):
+    def __init__(self, specs, mesh=None):
+        # mesh: place every buffer REPLICATED on it, so the fused suggest
+        # program can shard its scoring across the mesh without any
+        # per-suggest resharding transfers.  Replication is the right
+        # layout: the buffers are O(history) bytes (tiny next to the
+        # O(candidates × components) scoring compute the mesh exists
+        # for), and split/fit ops over them stay local on every device.
+        self._sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._sharding = NamedSharding(mesh, PartitionSpec())
         fams = {}
         for ki, (label, spec) in enumerate(specs.items()):
             if spec.dist in CONTINUOUS:
@@ -186,7 +197,12 @@ class DeviceHistory:
 
         if mask is None:
             if self._ones is None or self._ones.shape[0] != self.capt:
-                self._ones = jnp.ones(self.capt, bool)
+                ones = jnp.ones(self.capt, bool)
+                if self._sharding is not None:
+                    import jax
+
+                    ones = jax.device_put(ones, self._sharding)
+                self._ones = ones
             return self._ones
         buf = np.zeros(self.capt, bool)
         buf[: len(mask)] = mask
@@ -240,9 +256,14 @@ class DeviceHistory:
         self.sync_time += time.perf_counter() - t0
 
     def _upload(self, arr):
+        import jax
         import jax.numpy as jnp
 
+        # logical host->device bytes (replication fan-out not multiplied:
+        # the host pays the serialization once)
         self.bytes_uploaded += arr.nbytes
+        if self._sharding is not None:
+            return jax.device_put(arr, self._sharding)
         return jnp.asarray(arr)
 
     def _rebuild(self, hist):
@@ -375,17 +396,23 @@ def _apply_all_deltas(state, loss_idx, loss_vals, fam_deltas):
 _cache = weakref.WeakKeyDictionary()
 
 
-def device_history_for(trials, space):
-    """The (trials, space)-scoped DeviceHistory, weak-keyed on both sides
-    (no id()-reuse hazards, no unbounded growth)."""
+def device_history_for(trials, space, mesh=None):
+    """The (trials, space, mesh)-scoped DeviceHistory, weak-keyed on the
+    trials/space sides (no id()-reuse hazards, no unbounded growth).
+    ``mesh=None`` and each distinct mesh get separate mirrors — their
+    buffers live under different placements."""
     per_trials = _cache.get(trials)
     if per_trials is None:
         per_trials = weakref.WeakKeyDictionary()
         _cache[trials] = per_trials
-    dh = per_trials.get(space)
+    per_space = per_trials.get(space)
+    if per_space is None:
+        per_space = {}
+        per_trials[space] = per_space
+    dh = per_space.get(mesh)
     if dh is None:
-        dh = DeviceHistory(space.specs)
-        per_trials[space] = dh
+        dh = DeviceHistory(space.specs, mesh=mesh)
+        per_space[mesh] = dh
     return dh
 
 
@@ -471,10 +498,18 @@ def _family_suggest_core(
     quantized: bool,
     scorer: str,
     n_buckets: int = 0,
+    mesh=None,
 ):
     """ONE device program: γ-split → pack → Parzen fits → truncated-GMM
     draw → log l − log g → per-id argmax, stacked over the family's L
     labels.  Output: winning values [L, k] (fit space).
+
+    ``mesh`` (static): shard the scoring across it — pair scoring via
+    :func:`parallel.sharding.make_sharded_pair_score_batched` (candidates
+    over ``dp``, mixture components over ``sp``), quantized per-candidate
+    scoring via a ``dp`` sharding constraint on the candidate axis.  The
+    split/fit/draw stages stay replicated (O(history) work, negligible
+    next to the O(C·K) scoring the mesh exists for).
 
     ``n_buckets`` (static, >0 for BOUNDED quantized families): candidates
     of a quantized dist take at most that many DISTINCT grid values, so
@@ -529,6 +564,13 @@ def _family_suggest_core(
 
         score = jax.vmap(score_grid)(cands, *B, *A, lo, hi, qq)
     elif quantized or scorer == "exact":
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            cands = jax.lax.with_sharding_constraint(
+                cands, NamedSharding(mesh, PartitionSpec(None, "dp"))
+            )
+
         def score_one(cand, wb, mb, sb, wa, ma, sa, lo, hi, qq):
             return gmm_ops.gmm_lpdf(
                 cand, wb, mb, sb, lo, hi, qq, log_scale, quantized
@@ -539,16 +581,43 @@ def _family_suggest_core(
         z = jnp.log(jnp.maximum(cands, EPS)) if log_scale else cands
         params = jax.vmap(pair_params)(*B, *A)  # [L, 3, Kb+Ka]
         k_below = B[0].shape[1]
-        from ..ops.score import effective_scorer
-
-        if effective_scorer(scorer, params.shape[-1]) == "pallas":
-            score = pair_score_pallas_batched(z, params, k_below)
+        if mesh is not None:
+            score = _sharded_pair_apply(mesh, z, params, k_below)
         else:
-            score = jax.vmap(partial(pair_score, k_below=k_below))(z, params)
+            from ..ops.score import effective_scorer
+
+            if effective_scorer(scorer, params.shape[-1]) == "pallas":
+                score = pair_score_pallas_batched(z, params, k_below)
+            else:
+                score = jax.vmap(partial(pair_score, k_below=k_below))(z, params)
     score = score.reshape(L, k, n_cand)
     cands = cands.reshape(L, k, n_cand)
     idx = jnp.argmax(score, axis=2)  # [L, k]
     return jnp.take_along_axis(cands, idx[:, :, None], axis=2)[:, :, 0]
+
+
+def _sharded_pair_apply(mesh, z, params, k_below):
+    """Pad (C → |dp|-multiple, K → |sp|-multiple with NEG_BIG logit
+    columns, which contribute exactly zero mass) and run the sharded
+    batched pair scorer; slice back to the real candidate count."""
+    import jax.numpy as jnp
+
+    from ..ops.score import NEG_BIG
+    from ..parallel.sharding import make_sharded_pair_score_batched
+
+    n_dp = int(mesh.shape["dp"])
+    n_sp = int(mesh.shape["sp"])
+    L, C = z.shape
+    K = params.shape[-1]
+    c_pad = (-C) % n_dp
+    k_pad = (-K) % n_sp
+    if c_pad:
+        z = jnp.pad(z, ((0, 0), (0, c_pad)))
+    if k_pad:
+        pad_cols = jnp.zeros((L, 3, k_pad), params.dtype).at[:, 2, :].set(NEG_BIG)
+        params = jnp.concatenate([params, pad_cols], axis=2)
+    s = make_sharded_pair_score_batched(mesh)(z, params, jnp.int32(k_below))
+    return s[:, :C]
 
 
 def _index_family_suggest_core(

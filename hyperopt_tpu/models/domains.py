@@ -162,6 +162,64 @@ def _many_dists():
     return BenchDomain("many_dists", space, fn, quality_threshold=0.5, quality_evals=80)
 
 
+def _nested_arch():
+    """Deep conditional space (ML-architecture shaped): a top-level
+    branch choice where one branch carries an inner choice — exercises
+    multi-level activity masks the way the reference's conditional
+    test spaces do (hyperopt/tests/test_domains.py many_dists/choice)."""
+    space = hp.choice(
+        "arch",
+        [
+            {
+                "kind": 0,
+                "lr": hp.loguniform("mlp_lr", -6.0, 0.0),
+                "width": hp.quniform("mlp_width", 16, 128, 16),
+            },
+            {
+                "kind": 1,
+                "lr": hp.loguniform("cnn_lr", -6.0, 0.0),
+                "block": hp.choice(
+                    "cnn_block",
+                    [
+                        {"b": 0, "filters": hp.quniform("f_a", 8, 64, 8)},
+                        {"b": 1, "depth": hp.quniform("f_b", 1, 4, 1)},
+                    ],
+                ),
+            },
+        ],
+    )
+
+    def fn(c):
+        # optimum: cnn branch, block b=0, lr≈e^-3, filters≈40
+        lr_term = (math.log(c["lr"]) + 3.0) ** 2
+        if c["kind"] == 0:
+            return 1.0 + lr_term + abs(c["width"] - 64) / 64.0
+        if c["block"]["b"] == 0:
+            return lr_term + abs(c["block"]["filters"] - 40) / 40.0
+        return 0.5 + lr_term + abs(c["block"]["depth"] - 2) / 2.0
+
+    return BenchDomain(
+        "nested_arch", space, fn, quality_threshold=0.5, quality_evals=120, fmin=0.0
+    )
+
+
+def _rosen10():
+    """10-D Rosenbrock on [-2, 2]^10 — the zoo's high-dimensional
+    continuous domain (history_per_param stays small even at many
+    trials, the regime the ATPE featurizer must see in training)."""
+    space = {f"r{i}": hp.uniform(f"r{i}", -2.0, 2.0) for i in range(10)}
+
+    def fn(c):
+        x = np.array([c[f"r{i}"] for i in range(10)])
+        return float(
+            np.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2 + (1.0 - x[:-1]) ** 2)
+        )
+
+    return BenchDomain(
+        "rosen10", space, fn, quality_threshold=900.0, quality_evals=150, fmin=0.0
+    )
+
+
 def _make_all():
     ds = [
         _quadratic1(),
@@ -174,6 +232,8 @@ def _make_all():
         _branin(),
         _hartmann6(),
         _many_dists(),
+        _nested_arch(),
+        _rosen10(),
     ]
     return {d.name: d for d in ds}
 

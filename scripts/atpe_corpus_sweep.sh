@@ -1,0 +1,29 @@
+#!/bin/bash
+# Full ATPE corpus sweep (VERDICT r4 #3): one shard per training domain
+# (so partial progress survives interruption), then fit + held-out
+# validation + artifact write.  ~3h on one CPU core.
+#   bash scripts/atpe_corpus_sweep.sh [ROWS_DIR]
+set -u
+cd /root/repo || exit 1
+ROWS=${1:-/tmp/atpe_rows}
+mkdir -p "$ROWS"
+export JAX_PLATFORMS=cpu
+unset PALLAS_AXON_POOL_IPS
+
+DOMAINS="quadratic1 q1_lognormal n1 gauss_wave gauss_wave2 distractor hartmann6 many_dists nested_arch rosen10"
+
+for d in $DOMAINS; do
+  if [ -s "$ROWS/$d.pkl" ]; then
+    echo "$(date -u +%FT%TZ) shard $d already present, skipping"
+    continue
+  fi
+  echo "$(date -u +%FT%TZ) building shard $d"
+  python -m hyperopt_tpu.models.train_atpe \
+    --domains "$d" --seeds 13 --configs 20 --cont-evals 8 \
+    --checkpoints 20 28 36 45 --rows-out "$ROWS/$d.pkl" \
+    || echo "$(date -u +%FT%TZ) shard $d FAILED"
+done
+
+echo "$(date -u +%FT%TZ) fitting from shards"
+python -m hyperopt_tpu.models.train_atpe --fit-from "$ROWS"/*.pkl
+echo "$(date -u +%FT%TZ) sweep done"

@@ -8,6 +8,7 @@ parsing.
 """
 
 import os
+import sys
 import threading
 import time
 
@@ -370,3 +371,125 @@ class TestSubprocessWorkers:
                 p.wait(timeout=10)
             for f in logs:
                 f.close()
+
+
+class TestCrashInjection:
+    """SIGKILL a REAL worker process mid-reservation and mid-result-write
+    (VERDICT r4 #8): requeue_stale must recover the trial exactly once,
+    no doc lost, none double-run — the recovery the reference's Mongo
+    backend lacks (dead workers leave jobs reserved forever,
+    hyperopt/mongoexp.py reserve semantics ~L160-500).
+    """
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def _spawn(self, code, qdir, ready):
+        import subprocess
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        )
+        deadline = time.time() + 60
+        while not os.path.exists(ready):
+            if proc.poll() is not None:
+                raise AssertionError(
+                    "crash child exited early:\n"
+                    + proc.stdout.read().decode(errors="replace")
+                )
+            assert time.time() < deadline, "crash child never became ready"
+            time.sleep(0.05)
+        return proc
+
+    def _seed_queue(self, qdir):
+        jobs = FileJobs(qdir)
+        jobs.insert({
+            "tid": 0, "state": JOB_STATE_NEW, "spec": None,
+            "result": {"status": "new"},
+            "misc": {"tid": 0, "cmd": None, "idxs": {"x": [0]}, "vals": {"x": [1.0]}},
+            "exp_key": None, "owner": None, "book_time": None,
+            "refresh_time": None,
+        })
+        return jobs
+
+    def _assert_recovers_exactly_once(self, jobs, qdir):
+        import signal
+
+        # the dead worker's claim is visible: RUNNING + lock file held
+        [doc] = jobs.all_docs()
+        assert doc["state"] == JOB_STATE_RUNNING
+        assert os.path.exists(jobs.lock_path(0))
+        # a live worker cannot steal it before recovery
+        assert jobs.reserve("thief") is None
+        # recovery: exactly one requeue; doc intact and NEW again
+        assert jobs.requeue_stale(max_age_secs=-1.0) == 1
+        assert jobs.requeue_stale(max_age_secs=-1.0) == 0  # idempotent
+        [doc] = jobs.all_docs()
+        assert doc["state"] == JOB_STATE_NEW and doc["owner"] is None
+        # a second worker runs it to completion, exactly once
+        doc = jobs.reserve("rescuer")
+        assert doc is not None and doc["owner"] == "rescuer"
+        doc["state"] = JOB_STATE_DONE
+        doc["result"] = {"status": "ok", "loss": 0.5}
+        jobs.write(doc)
+        docs = jobs.all_docs()
+        assert len(docs) == 1  # no doc lost, none duplicated
+        assert docs[0]["state"] == JOB_STATE_DONE
+        assert docs[0]["result"]["loss"] == 0.5
+        assert docs[0]["owner"] == "rescuer"
+
+    def test_sigkill_mid_reservation(self, tmp_path):
+        import signal
+
+        qdir = str(tmp_path / "q")
+        ready = str(tmp_path / "ready")
+        jobs = self._seed_queue(qdir)
+        code = f"""
+import sys, time
+sys.path.insert(0, {self.REPO!r})
+from hyperopt_tpu.parallel.file_trials import FileJobs
+jobs = FileJobs({qdir!r})
+doc = jobs.reserve("crash-worker")
+assert doc is not None, "nothing to reserve"
+open({ready!r}, "w").write(str(doc["tid"]))
+time.sleep(300)  # SIGKILLed here, reservation held
+"""
+        proc = self._spawn(code, qdir, ready)
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+        self._assert_recovers_exactly_once(jobs, qdir)
+
+    def test_sigkill_mid_result_write(self, tmp_path):
+        import signal
+
+        qdir = str(tmp_path / "q")
+        ready = str(tmp_path / "ready")
+        jobs = self._seed_queue(qdir)
+        # the child stalls INSIDE the result write: tmp file written and
+        # fsynced, the atomic os.replace not yet executed — the kill lands
+        # exactly in the torn-write window
+        code = f"""
+import sys, time, os
+sys.path.insert(0, {self.REPO!r})
+from hyperopt_tpu.parallel import file_trials as ft
+jobs = ft.FileJobs({qdir!r})
+doc = jobs.reserve("crash-worker-2")
+assert doc is not None, "nothing to reserve"
+doc["state"] = {JOB_STATE_DONE}
+doc["result"] = {{"status": "ok", "loss": 99.0}}
+def hang(src, dst):
+    open({ready!r}, "w").write("mid-write")
+    time.sleep(300)  # SIGKILLed here, replace pending
+ft.os.replace = hang
+jobs.write(doc)
+"""
+        proc = self._spawn(code, qdir, ready)
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+        # atomicity held: the doc is the PRE-write (RUNNING) version, not
+        # a torn file, and the dead worker's phantom result never lands
+        self._assert_recovers_exactly_once(jobs, qdir)
+        # the orphaned tmp file (if any) must not confuse the queue scan
+        assert jobs.count_states()[JOB_STATE_DONE] == 1

@@ -547,3 +547,101 @@ class TestLargeHistorySharding:
         # 20k sharp quadratic observations: the posterior concentrates
         # hard around the optimum
         assert abs(x) < 1.0, x
+
+
+class TestUnifiedMeshPath:
+    """VERDICT r4 #2: tpe.suggest(mesh=...) rides the device-resident
+    history + fused multi-family programs, with scoring sharded."""
+
+    def test_sharded_pair_score_batched_parity(self):
+        """The batched sharded pair scorer == single-device pair_score,
+        with the below/above boundary straddling shard boundaries."""
+        from hyperopt_tpu.ops.score import NEG_BIG, pair_params, pair_score
+        from hyperopt_tpu.parallel.sharding import (
+            make_sharded_pair_score_batched,
+        )
+
+        mesh = default_mesh()
+        dp, sp = int(mesh.shape["dp"]), int(mesh.shape["sp"])
+        rng = np.random.default_rng(0)
+        L, C = 3, 64 * dp
+        kb, ka = 13, 41  # deliberately NOT sp-aligned
+
+        def mk(k):
+            w = (np.abs(rng.normal(size=(L, k))) + 0.1).astype(np.float32)
+            return (
+                w / w.sum(axis=1, keepdims=True),
+                rng.normal(size=(L, k)).astype(np.float32),
+                (np.abs(rng.normal(size=(L, k))) + 0.3).astype(np.float32),
+            )
+
+        B, A = mk(kb), mk(ka)
+        z = rng.uniform(-3, 3, (L, C)).astype(np.float32)
+        params = jax.vmap(pair_params)(*B, *A)  # [L, 3, kb+ka]
+        ref = np.stack([
+            np.asarray(pair_score(jnp.asarray(z[i]), params[i], kb))
+            for i in range(L)
+        ])
+        # pad K to an sp multiple with NEG_BIG logit columns (zero mass)
+        K = kb + ka
+        k_pad = (-K) % sp
+        pad_cols = jnp.zeros((L, 3, k_pad), params.dtype).at[:, 2, :].set(NEG_BIG)
+        pp = jnp.concatenate([params, pad_cols], axis=2)
+        got = np.asarray(
+            make_sharded_pair_score_batched(mesh)(
+                jnp.asarray(z), pp, jnp.int32(kb)
+            )
+        )
+        np.testing.assert_allclose(got, ref, atol=2e-4)
+
+    def test_mesh_host_bytes_flat_as_history_grows(self):
+        """The mesh route's host->device traffic per suggest must be O(k),
+        independent of history size — the VERDICT r4 #2 'done' gate."""
+        from hyperopt_tpu import Domain
+        from hyperopt_tpu.algos import tpe_device
+        from hyperopt_tpu.base import JOB_STATE_DONE, STATUS_OK
+
+        space = {
+            "x": hp.uniform("x", -5, 5),
+            "w": hp.quniform("w", 0, 100, 5),
+        }
+        domain = Domain(lambda c: c["x"] ** 2, space)
+        mesh = default_mesh()
+        rng = np.random.default_rng(0)
+
+        def mk_doc(i):
+            x = float(rng.uniform(-5, 5))
+            w = float(np.round(rng.uniform(0, 100) / 5) * 5)
+            return {
+                "tid": i, "spec": None,
+                "result": {"status": STATUS_OK, "loss": x * x},
+                "misc": {"tid": i, "cmd": None,
+                         "idxs": {"x": [i], "w": [i]},
+                         "vals": {"x": [x], "w": [w]}},
+                "state": JOB_STATE_DONE, "owner": None,
+                "book_time": None, "refresh_time": None, "exp_key": None,
+            }
+
+        def per_suggest_bytes(n0, rounds=4):
+            trials = Trials()
+            trials._insert_trial_docs([mk_doc(i) for i in range(n0)])
+            trials.refresh()
+            # warm: first suggest pays the one-time full upload
+            tpe.suggest([10**6], domain, trials, seed=1, mesh=mesh,
+                        n_EI_candidates=128)
+            dh = tpe_device.device_history_for(trials, domain.space, mesh=mesh)
+            b0 = dh.bytes_uploaded
+            for r in range(rounds):
+                trials._insert_trial_docs([mk_doc(n0 + r)])
+                trials.refresh()
+                tpe.suggest([10**6 + r + 1], domain, trials, seed=2 + r,
+                            mesh=mesh, n_EI_candidates=128)
+            assert dh.full_rebuilds == 1, "append must stay incremental"
+            return (dh.bytes_uploaded - b0) / rounds
+
+        # capacities chosen inside one power-of-two bucket (1025..2045 and
+        # 4097..8188) so no in-test bucket growth muddies the measurement
+        small = per_suggest_bytes(1025)
+        large = per_suggest_bytes(4097)
+        assert small < 4096, small  # O(k) scalars, not the history
+        assert large <= small * 1.5 + 256, (small, large)
