@@ -161,6 +161,73 @@ def _continue_with(domain, snapshot_docs, cfg, extra_evals, seed):
     return float(np.min(losses)) if losses else float("inf")
 
 
+def label_results(results):
+    """State labels from (final_best, cfg) continuation results.
+
+    Top-quartile majority voting (the round-4 scheme) was measurably
+    noisy: with ~20 configs per state the filtering-mode majority was
+    close to uniform chance, and the shipped models learned to predict
+    ``random`` filtering — i.e. throw away a third of the history —
+    which LOST to the heuristic on held-out domains.  Instead:
+
+    - continuous targets: rank-weighted mean over ALL configs
+      (``w ∝ exp(−rank/(n/4))`` — smooth, emphasizes winners, uses every
+      observation instead of the top 5);
+    - filtering mode: the mode whose configs' MEDIAN final best is
+      lowest (an entire-group comparison, robust to one lucky draw);
+    - multiplier: rank-weighted mean within the winning mode (1.0 for
+      ``none``, where it is meaningless).
+
+    The raw results ride along under ``_results`` so future re-labelings
+    can rerun from pickled shards without re-sweeping.
+    """
+    if not results:
+        raise ValueError("label_results: empty continuation results")
+    results = sorted(results, key=lambda r: r[0])
+    n = len(results)
+    w = np.exp(-np.arange(n) / max(1.0, n / 4.0))
+    w = w / w.sum()
+
+    def wmean(key, transform=lambda v: v):
+        return float(sum(
+            wi * transform(cfg[key]) for wi, (_, cfg) in zip(w, results)
+        ))
+
+    by_mode = {}
+    for best, cfg in results:
+        by_mode.setdefault(cfg["result_filtering_mode"], []).append(best)
+    mode = min(by_mode, key=lambda m: float(np.median(by_mode[m])))
+    if mode == "none":
+        mult = 1.0
+    else:
+        mw = np.array(
+            [wi for wi, (_, c) in zip(w, results)
+             if c["result_filtering_mode"] == mode]
+        )
+        mv = [c["result_filtering_multiplier"] for _, c in results
+              if c["result_filtering_mode"] == mode]
+        mult = float(np.average(mv, weights=mw)) if mw.sum() > 0 else 1.0
+    return {
+        "gamma": wmean("gamma"),
+        "n_EI_candidates": wmean("n_EI_candidates", np.log2),
+        "prior_weight": wmean("prior_weight"),
+        "secondary_cutoff": wmean("secondary_cutoff"),
+        "result_filtering_mode": mode,
+        "result_filtering_multiplier": mult,
+        "_results": [(b, dict(c)) for b, c in results],
+    }
+
+
+def relabel_rows(rows):
+    """Recompute labels from the raw ``_results`` stored in each row
+    (no-op for legacy rows without them)."""
+    out = []
+    for feats, labels in rows:
+        raw = labels.get("_results")
+        out.append((feats, label_results(raw)) if raw else (feats, labels))
+    return out
+
+
 def build_corpus(domains, seeds, checkpoints, n_configs, cont_evals, log=print):
     from hyperopt_tpu.base import Domain
     from . import domains as zoo
@@ -193,25 +260,7 @@ def build_corpus(domains, seeds, checkpoints, n_configs, cont_evals, log=print):
                         domain, snapshot, cfg, cont_evals, seed * 1000 + ci
                     )
                     results.append((best, cfg))
-                results.sort(key=lambda r: r[0])
-                top = [cfg for _, cfg in results[: max(2, len(results) // 4)]]
-                labels = {
-                    "gamma": float(np.mean([c["gamma"] for c in top])),
-                    "n_EI_candidates": float(
-                        np.mean([np.log2(c["n_EI_candidates"]) for c in top])
-                    ),
-                    "prior_weight": float(np.mean([c["prior_weight"] for c in top])),
-                    "secondary_cutoff": float(
-                        np.mean([c["secondary_cutoff"] for c in top])
-                    ),
-                    "result_filtering_mode": max(
-                        set(c["result_filtering_mode"] for c in top),
-                        key=[c["result_filtering_mode"] for c in top].count,
-                    ),
-                    "result_filtering_multiplier": float(
-                        np.mean([c["result_filtering_multiplier"] for c in top])
-                    ),
-                }
+                labels = label_results(results)
                 rows.append((feats, labels))
                 log(
                     f"  state {dname}/s{seed}/n{ckpt}: "
@@ -336,6 +385,7 @@ def _fit_validate_write(rows, out):
     if not rows:
         print("train_atpe: empty corpus, nothing written", file=sys.stderr)
         return 1
+    rows = relabel_rows(rows)  # idempotent; upgrades shards on scheme changes
     models, scaling = fit_models(rows)
     held = _held_out_regret(models, scaling)
     scaling["provenance"] = {

@@ -645,3 +645,25 @@ class TestUnifiedMeshPath:
         large = per_suggest_bytes(4097)
         assert small < 4096, small  # O(k) scalars, not the history
         assert large <= small * 1.5 + 256, (small, large)
+
+    def test_mesh_and_device_paths_agree(self):
+        """The unified route makes mesh vs single-device a SCORING-layout
+        choice, not an algorithm fork: same seed -> same suggestions
+        (same RNG keys, same fits; the sharded pair scorer's f32
+        rounding does not flip the EI argmax on this seeded history)."""
+        from hyperopt_tpu import Domain
+
+        d = domains.get("branin")
+        trials = Trials()
+        fmin(
+            d.fn, d.space, algo=rand.suggest, max_evals=30, trials=trials,
+            rstate=np.random.default_rng(5), show_progressbar=False,
+            verbose=False,
+        )
+        domain = Domain(d.fn, d.space)
+        a = tpe.suggest([500, 501], domain, trials, seed=13, mesh=None,
+                        n_EI_candidates=512)
+        b = tpe.suggest([500, 501], domain, trials, seed=13,
+                        mesh=default_mesh(), n_EI_candidates=512)
+        for da, db in zip(a, b):
+            assert da["misc"]["vals"] == db["misc"]["vals"]

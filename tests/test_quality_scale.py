@@ -1,0 +1,49 @@
+"""Candidate-scale quality guard (VERDICT r4 #4).
+
+`scripts/quality_study.py` (committed results: QUALITY.md / QUALITY.json)
+measures whether raising ``n_EI_candidates`` buys optimization quality.
+These tests guard the HEADLINE configuration — the TPU-default large
+candidate count — against silent quality regressions: scoring 8192
+candidates must still optimize (the EI argmax over a huge sample of
+l(x) draws must not wander into pathological tails), on both the
+single-device and mesh paths.
+"""
+
+from functools import partial
+
+import numpy as np
+
+from hyperopt_tpu import Trials, fmin
+from hyperopt_tpu.algos import tpe
+from hyperopt_tpu.models import domains
+
+
+def _best(dname, n_cand, seed, mesh=None, max_evals=40):
+    d = domains.get(dname)
+    trials = Trials()
+    fmin(
+        d.fn, d.space,
+        algo=partial(tpe.suggest, n_EI_candidates=n_cand, mesh=mesh),
+        max_evals=max_evals, trials=trials,
+        rstate=np.random.default_rng(seed),
+        show_progressbar=False, verbose=False,
+    )
+    return min(l for l in trials.losses() if l is not None and not np.isnan(l))
+
+
+def test_headline_candidate_count_still_optimizes():
+    """quadratic1 at c=8192 must meet the domain's own quality threshold
+    (the same bar the c=24 default is held to in test_tpe.py)."""
+    d = domains.get("quadratic1")
+    vals = [_best("quadratic1", 8192, s, max_evals=d.quality_evals) for s in (0, 1)]
+    assert float(np.mean(vals)) < d.quality_threshold, vals
+
+
+def test_candidate_scale_not_catastrophic_on_mesh():
+    """Mesh path at c=8192: same threshold bar, sharded scoring."""
+    from hyperopt_tpu.parallel.sharding import default_mesh
+
+    d = domains.get("quadratic1")
+    best = _best("quadratic1", 8192, 3, mesh=default_mesh(),
+                 max_evals=d.quality_evals)
+    assert best < d.quality_threshold, best

@@ -165,3 +165,39 @@ def test_fma_timing_probe_selects_a_mode():
     finally:
         pallas_gmm._fma_measured_default = prior
         pallas_gmm._fma_measured_default_unbatched = prior_ub
+
+
+def test_mesh_suggest_unified_path_on_chip():
+    """tpe.suggest(mesh=…) on a 1-chip mesh: the unified device-history
+    route (shard_map pair scorer included) must lower and run on real
+    hardware, not only on the virtual CPU mesh."""
+    from hyperopt_tpu import Trials, hp
+    from hyperopt_tpu.base import JOB_STATE_DONE, STATUS_OK, Domain
+    from hyperopt_tpu.algos import tpe
+    from hyperopt_tpu.parallel.sharding import default_mesh
+
+    rng = np.random.default_rng(2)
+    space = {"x": hp.uniform("x", -5, 5), "w": hp.quniform("w", 0, 100, 5)}
+    domain = Domain(lambda c: c["x"] ** 2, space)
+    docs = []
+    for i in range(40):
+        x = float(rng.uniform(-5, 5))
+        w = float(np.round(rng.uniform(0, 100) / 5) * 5)
+        docs.append({
+            "tid": i, "spec": None,
+            "result": {"status": STATUS_OK, "loss": x * x},
+            "misc": {"tid": i, "cmd": None,
+                     "idxs": {"x": [i], "w": [i]},
+                     "vals": {"x": [x], "w": [w]}},
+            "state": JOB_STATE_DONE, "owner": None,
+            "book_time": None, "refresh_time": None, "exp_key": None,
+        })
+    trials = Trials()
+    trials._insert_trial_docs(docs)
+    trials.refresh()
+    mesh = default_mesh()  # 1 real chip -> dp=1, sp=1 (shard_map still runs)
+    out = tpe.suggest([100], domain, trials, seed=7, mesh=mesh,
+                      n_EI_candidates=512)
+    v = out[0]["misc"]["vals"]
+    assert -5.0 <= v["x"][0] <= 5.0
+    assert v["w"][0] % 5 == 0
