@@ -324,12 +324,21 @@ class ATPEOptimizer:
         return x
 
     def predict_meta(self, feats):
-        """Meta-parameters for this suggest step (models else heuristics)."""
+        """Meta-parameters for this suggest step (models else heuristics).
+
+        A shipped model only OVERRIDES the heuristic rule for targets in
+        the artifact's ``active_targets`` — the set that showed genuine
+        cross-domain skill in the trainer's grouped CV
+        (``train_atpe.fit_models``).  Artifacts predating the field
+        activate everything (back-compat)."""
         meta = self._heuristic_meta(feats)
         transforms = (self.scaling or {}).get("transforms", {})
+        active = (self.scaling or {}).get("active_targets")
         if self.models:
             x = self._vectorize(feats)
             for target, model in self.models.items():
+                if active is not None and target not in active:
+                    continue  # no CV-proven skill: heuristic rules
                 try:
                     pred = model.predict(x)[0]
                 except Exception as e:  # corrupt artifact: keep heuristic

@@ -273,16 +273,41 @@ class TestShippedArtifacts:
         assert meta["result_filtering_mode"] in atpe.FILTER_MODES
         assert 0.2 <= meta["result_filtering_multiplier"] <= 1.0
 
-    def test_artifact_atpe_not_worse_than_heuristic(self):
-        """Artifact-driven ATPE >= heuristic ATPE on the domain zoo
-        (VERDICT r3 #3).  Averaged over domains x seeds with slack: both
-        are stochastic optimizers; the artifacts must not LOSE."""
+    def test_corpus_is_real(self):
+        """A 24-row corpus regression must fail loudly (VERDICT r4 #3):
+        the shipped GBMs must be trained on a meaningfully sized sweep,
+        with the held-out validation recorded in the artifact."""
+        import json
+
+        with open(
+            os.path.join(atpe.DEFAULT_MODEL_DIR, "scaling_model.json")
+        ) as f:
+            scaling = json.load(f)
+        assert scaling["corpus_rows"] >= 500, scaling["corpus_rows"]
+        prov = scaling.get("provenance", {})
+        from hyperopt_tpu.models.train_atpe import HELD_OUT
+
+        assert set(prov.get("held_out_domains", ())) == set(HELD_OUT)
+        # the ARTIFACT's own recorded training domains must exclude the
+        # held-out pair — the generalization claim is about what the
+        # shipped models saw, not what the trainer's constant says today
+        assert prov.get("train_domains"), prov
+        assert not set(prov["train_domains"]) & set(HELD_OUT), prov
+
+    def test_artifact_atpe_not_worse_than_heuristic_held_out(self):
+        """Artifact-driven ATPE >= heuristic ATPE on domains the trainer
+        NEVER saw (train_atpe.HELD_OUT) — generalization, not recall
+        (VERDICT r4 #3).  Averaged over domains x seeds; slack <= 0: the
+        artifacts must not lose."""
         from functools import partial
 
+        from hyperopt_tpu.models.train_atpe import DEFAULT_DOMAINS, HELD_OUT
+
+        assert not set(HELD_OUT) & set(DEFAULT_DOMAINS)  # truly unseen
         diffs = []
-        for dname in ("quadratic1", "gauss_wave2"):
+        for dname in HELD_OUT:
             d = domains.get(dname)
-            for seed in (0, 1):
+            for seed in (0, 1, 2):
                 finals = {}
                 for kind, mdir in (("artifact", None), ("heuristic", "")):
                     trials = Trials()
@@ -301,7 +326,7 @@ class TestShippedArtifacts:
                 scale = abs(finals["heuristic"]) + 0.1
                 diffs.append((finals["artifact"] - finals["heuristic"]) / scale)
         mean_diff = float(np.mean(diffs))
-        assert mean_diff <= 0.25, (mean_diff, diffs)
+        assert mean_diff <= 0.0, (mean_diff, diffs)
 
     def test_atpe_uses_artifacts_by_default(self, caplog):
         d = domains.get("branin")
