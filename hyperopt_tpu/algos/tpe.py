@@ -180,15 +180,6 @@ def ap_split_trials(loss_tids, losses, gamma, gamma_cap=_default_linear_forgetti
 
 
 # ---------------------------------------------------------------------
-# Per-distribution posterior configuration — single source of truth in
-# tpe_device (shared by the host/mesh path here and the device path)
-# ---------------------------------------------------------------------
-
-from .tpe_device import CONTINUOUS as _CONTINUOUS  # noqa: E402
-from .tpe_device import prior_for as _prior_for  # noqa: E402
-
-
-# ---------------------------------------------------------------------
 # Jitted per-label kernels (fit + sample + score + argmax in one program)
 # ---------------------------------------------------------------------
 
