@@ -12,6 +12,10 @@ The reference's two plugin boundaries are preserved exactly:
 subclassing for execution backends.
 """
 
+# the reference re-exports functools.partial at package level
+# (hyperopt/__init__.py); kept for drop-in `hyperopt.partial` users
+from functools import partial
+
 from . import hp, pyll
 from .base import (
     JOB_STATE_CANCEL,
@@ -121,6 +125,7 @@ __all__ = [
     "hp",
     "mix",
     "no_progress_loss",
+    "partial",
     "pyll",
     "rand",
     "space_eval",

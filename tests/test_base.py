@@ -508,3 +508,21 @@ def test_history_cache_atomic_under_noncastable_tid():
     t.refresh()
     assert list(t.history.vals["x"]) == good
     assert len(t.history.loss_tids) == len(t.history.idxs["x"]) == 3
+
+
+def test_package_export_surface():
+    """The reference's package-level names resolve on hyperopt_tpu —
+    the drop-in-import contract (hyperopt/__init__.py exports, SURVEY
+    §2 #23), including the functools.partial re-export."""
+    import hyperopt_tpu as h
+
+    for name in (
+        "fmin", "hp", "tpe", "atpe", "rand", "anneal", "mix", "Trials",
+        "space_eval", "pyll", "partial", "trials_from_docs", "Domain",
+        "FMinIter", "STATUS_OK", "STATUS_FAIL", "STATUS_STRINGS",
+        "JOB_STATE_NEW", "JOB_STATE_DONE", "JOB_STATE_ERROR",
+        "no_progress_loss",
+    ):
+        assert hasattr(h, name), name
+    for name in h.__all__:
+        assert hasattr(h, name), f"__all__ lists missing name {name}"
