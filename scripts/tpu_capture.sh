@@ -16,7 +16,7 @@ while true; do
     echo "$(date -u +%FT%TZ) both TPU artifacts present; watcher done"
     break
   fi
-  if timeout 180 python -c "import jax; assert jax.devices()[0].platform=='tpu'" >/dev/null 2>&1; then
+  if timeout -k 15 180 python -c "import jax; assert jax.devices()[0].platform=='tpu'" >/dev/null 2>&1; then
     echo "$(date -u +%FT%TZ) tunnel ALIVE"
     if ! have_tpu_artifact BENCH_TPU.json; then
       # the tunnel can die again within minutes: grab a fast-but-complete
@@ -24,7 +24,7 @@ while true; do
       # reps), then upgrade to the full-rep run if the window holds
       echo "$(date -u +%FT%TZ) running fast headline bench..."
       if BENCH_TIMED=8 BENCH_LOOP_ITERS=20 BENCH_BATCH_REPS=2 \
-         timeout 2400 python bench.py >/tmp/bench_tpu_out.json 2>/tmp/bench_tpu_err.log \
+         timeout -k 30 2400 python bench.py >/tmp/bench_tpu_out.json 2>/tmp/bench_tpu_err.log \
          && have_tpu_artifact /tmp/bench_tpu_out.json; then
         cp /tmp/bench_tpu_out.json BENCH_TPU.json
         echo "$(date -u +%FT%TZ) captured BENCH_TPU.json (fast reps)"
@@ -36,7 +36,7 @@ while true; do
     if have_tpu_artifact BENCH_TPU.json && ! have_tpu_artifact BENCH_TPU_100k.json; then
       echo "$(date -u +%FT%TZ) running 100k-history bench (AB off)..."
       if BENCH_N_HISTORY=100000 BENCH_AB=0 BENCH_TIMED=15 \
-         timeout 3600 python bench.py >/tmp/bench_tpu100k_out.json 2>/tmp/bench_tpu100k_err.log \
+         timeout -k 30 3600 python bench.py >/tmp/bench_tpu100k_out.json 2>/tmp/bench_tpu100k_err.log \
          && have_tpu_artifact /tmp/bench_tpu100k_out.json; then
         cp /tmp/bench_tpu100k_out.json BENCH_TPU_100k.json
         echo "$(date -u +%FT%TZ) captured BENCH_TPU_100k.json"
@@ -47,7 +47,7 @@ while true; do
     fi
     if have_tpu_artifact BENCH_TPU.json && ! [ -s BENCH_TPU_full.json ]; then
       echo "$(date -u +%FT%TZ) running full-rep headline bench..."
-      if timeout 3600 python bench.py >/tmp/bench_tpu_full.json 2>/tmp/bench_tpu_full_err.log \
+      if timeout -k 30 3600 python bench.py >/tmp/bench_tpu_full.json 2>/tmp/bench_tpu_full_err.log \
          && have_tpu_artifact /tmp/bench_tpu_full.json; then
         cp /tmp/bench_tpu_full.json BENCH_TPU_full.json
         echo "$(date -u +%FT%TZ) captured BENCH_TPU_full.json"
