@@ -506,8 +506,13 @@ def suggest(
     n_startup_jobs=_default_n_startup_jobs,
     model_dir=None,
     verbose=True,
+    mesh=None,
 ):
-    """ATPE suggest: featurize → meta-params → TPE with parameter locks."""
+    """ATPE suggest: featurize → meta-params → TPE with parameter locks.
+
+    ``mesh``: forwarded to :func:`tpe.suggest` — the meta-driven TPE step
+    runs through the unified sharded path (ATPE exists for LARGE
+    histories, exactly where the mesh pays)."""
     hist = trials.history
     # same startup gate as tpe.suggest: all inserted non-error trials
     # (reference semantics), plus an empty-OK-history guard
@@ -548,4 +553,5 @@ def suggest(
         gamma=meta["gamma"],
         param_locks=param_locks or None,
         trial_filter=trial_filter,
+        mesh=mesh,
     )

@@ -398,3 +398,21 @@ def test_corr_join_unaffected_by_nan_losses():
     # loss is literally the parameter value -> rank correlation must be
     # exactly 1.0 on the 39 finite pairs; a shifted join scrambles it
     assert per_param["x"] == pytest.approx(1.0, abs=1e-9)
+
+
+def test_atpe_suggest_with_mesh():
+    """atpe.suggest(mesh=...) forwards to the unified sharded TPE path
+    and produces the same suggestion as the single-device route (the
+    meta layer is host-side and identical; only the scoring layout
+    differs)."""
+    from hyperopt_tpu.parallel.sharding import default_mesh
+
+    d = domains.get("quadratic1")
+    trials = seeded_trials(d, n=40)
+    domain = Domain(d.fn, d.space)
+    dev = atpe.suggest([700], domain, trials, seed=9)
+    msh = atpe.suggest([700], domain, trials, seed=9, mesh=default_mesh())
+    a = dev[0]["misc"]["vals"]["x"][0]
+    b = msh[0]["misc"]["vals"]["x"][0]
+    assert abs(a - b) < 1e-4 * max(1.0, abs(a)), (a, b)
+    assert -5.0 <= b <= 5.0
