@@ -41,24 +41,34 @@ SPACE = {"x": hp.uniform("x", -5, 5)}
 
 
 def run_workers(queue_dir, n_workers=2, max_jobs=1000):
-    """Threaded in-process workers (the reference's with_worker_threads)."""
+    """Threaded in-process workers (the reference's with_worker_threads).
+
+    Returns ``(threads, stop)``.  Workers poll THROUGH ``ReserveTimeout``
+    until ``stop`` is set: a transiently empty queue (the fmin driver
+    thread descheduled on a loaded single-core box) must not make a
+    worker exit for good while fmin still has trials to enqueue —
+    with every worker gone, fmin's poll loop blocks forever (observed
+    as a suite deadlock in test_worker_error_path).  Call sites set
+    ``stop`` once fmin returns, then join.
+    """
+    stop = threading.Event()
 
     def loop():
         w = FileWorker(queue_dir, poll_interval=0.02)
         done = 0
-        while done < max_jobs:
+        while done < max_jobs and not stop.is_set():
             try:
                 w.run_one(reserve_timeout=0.5)
                 done += 1
             except ReserveTimeout:
-                return
+                continue
             except Exception:
                 pass
 
     threads = [threading.Thread(target=loop, daemon=True) for _ in range(n_workers)]
     for t in threads:
         t.start()
-    return threads
+    return threads, stop
 
 
 class TestFileJobs:
@@ -137,11 +147,12 @@ class TestFileJobs:
 class TestFileTrialsFmin:
     def test_fmin_with_threaded_workers(self, tmp_path):
         trials = FileTrials(str(tmp_path / "q"))
-        threads = run_workers(str(tmp_path / "q"), n_workers=3)
+        threads, stop = run_workers(str(tmp_path / "q"), n_workers=3)
         best = fmin(
             quad_objective, SPACE, algo=rand.suggest, max_evals=20, trials=trials,
             rstate=np.random.default_rng(0), show_progressbar=False, verbose=False,
         )
+        stop.set()
         for t in threads:
             t.join(timeout=5)
         assert len(trials) == 20
@@ -153,22 +164,24 @@ class TestFileTrialsFmin:
     def test_durability_resume(self, tmp_path):
         qdir = str(tmp_path / "q")
         trials = FileTrials(qdir)
-        threads = run_workers(qdir, n_workers=2)
+        threads, stop = run_workers(qdir, n_workers=2)
         fmin(
             quad_objective, SPACE, algo=rand.suggest, max_evals=10, trials=trials,
             rstate=np.random.default_rng(0), show_progressbar=False, verbose=False,
         )
+        stop.set()
         for t in threads:
             t.join(timeout=5)
         # a brand-new store on the same dir sees everything (Mongo-style
         # durability); resuming fmin continues to 15
         trials2 = FileTrials(qdir)
         assert len(trials2) == 10
-        threads = run_workers(qdir, n_workers=2)
+        threads, stop = run_workers(qdir, n_workers=2)
         fmin(
             quad_objective, SPACE, algo=rand.suggest, max_evals=15, trials=trials2,
             rstate=np.random.default_rng(1), show_progressbar=False, verbose=False,
         )
+        stop.set()
         for t in threads:
             t.join(timeout=5)
         assert len(FileTrials(qdir)) == 15
@@ -177,13 +190,14 @@ class TestFileTrialsFmin:
         qdir = str(tmp_path / "q")
         trials = FileTrials(qdir)
 
-        threads = run_workers(qdir, n_workers=2)
+        threads, stop = run_workers(qdir, n_workers=2)
         fmin(
             flaky_objective, SPACE, algo=rand.suggest, max_evals=12,
             trials=trials, catch_eval_exceptions=True,
             rstate=np.random.default_rng(3), show_progressbar=False, verbose=False,
             return_argmin=False,
         )
+        stop.set()
         for t in threads:
             t.join(timeout=5)
         trials.refresh()
@@ -240,13 +254,14 @@ class TestWorkerCLI:
         qdir = str(tmp_path / "q")
         trials = FileTrials(qdir)
 
-        threads = run_workers(qdir, n_workers=1)
+        threads, stop = run_workers(qdir, n_workers=1)
         fmin(
             checkpointing_objective, SPACE, algo=rand.suggest, max_evals=2,
             trials=trials, rstate=np.random.default_rng(0),
             show_progressbar=False, verbose=False, return_argmin=False,
             pass_expr_memo_ctrl=None,
         )
+        stop.set()
         for t in threads:
             t.join(timeout=5)
         assert len(FileTrials(qdir)) == 2
