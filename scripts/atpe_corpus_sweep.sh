@@ -2,10 +2,15 @@
 # Full ATPE corpus sweep (VERDICT r4 #3): one shard per training domain
 # (so partial progress survives interruption), then fit + held-out
 # validation + artifact write.  ~3h on one CPU core.
-#   bash scripts/atpe_corpus_sweep.sh [ROWS_DIR]
+#   bash scripts/atpe_corpus_sweep.sh [ROWS_DIR] [SEEDS] [SEED_OFFSET]
+# SEED_OFFSET gives a disjoint seed range (corpus rows are deterministic
+# per seed, so a replication run MUST use a non-overlapping offset or it
+# regenerates the original rows).  SKIP_FIT=1 builds shards only.
 set -u
 cd /root/repo || exit 1
 ROWS=${1:-/tmp/atpe_rows}
+SEEDS=${2:-13}
+SEED_OFFSET=${3:-0}
 mkdir -p "$ROWS"
 export JAX_PLATFORMS=cpu
 unset PALLAS_AXON_POOL_IPS
@@ -13,17 +18,25 @@ unset PALLAS_AXON_POOL_IPS
 DOMAINS="quadratic1 q1_lognormal n1 gauss_wave gauss_wave2 distractor hartmann6 many_dists nested_arch rosen10"
 
 for d in $DOMAINS; do
-  if [ -s "$ROWS/$d.pkl" ]; then
-    echo "$(date -u +%FT%TZ) shard $d already present, skipping"
+  # seed range in the shard name: a rerun with different SEEDS/OFFSET
+  # must not silently reuse (or mix with) another range's shards
+  SHARD="$ROWS/$d.s${SEED_OFFSET}_${SEEDS}.pkl"
+  if [ -s "$SHARD" ]; then
+    echo "$(date -u +%FT%TZ) shard $SHARD already present, skipping"
     continue
   fi
-  echo "$(date -u +%FT%TZ) building shard $d"
+  echo "$(date -u +%FT%TZ) building shard $SHARD"
   python -m hyperopt_tpu.models.train_atpe \
-    --domains "$d" --seeds 13 --configs 20 --cont-evals 8 \
-    --checkpoints 20 28 36 45 --rows-out "$ROWS/$d.pkl" \
+    --domains "$d" --seeds "$SEEDS" --seed-offset "$SEED_OFFSET" \
+    --configs 20 --cont-evals 8 \
+    --checkpoints 20 28 36 45 --rows-out "$SHARD" \
     || echo "$(date -u +%FT%TZ) shard $d FAILED"
 done
 
+if [ "${SKIP_FIT:-0}" = "1" ]; then
+  echo "$(date -u +%FT%TZ) shards done (SKIP_FIT=1)"
+  exit 0
+fi
 echo "$(date -u +%FT%TZ) fitting from shards"
 python -m hyperopt_tpu.models.train_atpe --fit-from "$ROWS"/*.pkl
 echo "$(date -u +%FT%TZ) sweep done"

@@ -63,7 +63,9 @@ def run_workers(queue_dir, n_workers=2, max_jobs=1000):
             except ReserveTimeout:
                 continue
             except Exception:
-                pass
+                # e.g. the tmp_path queue dir was deleted after a test
+                # failure leaked this thread: don't busy-spin on it
+                time.sleep(0.05)
 
     threads = [threading.Thread(target=loop, daemon=True) for _ in range(n_workers)]
     for t in threads:
@@ -148,11 +150,14 @@ class TestFileTrialsFmin:
     def test_fmin_with_threaded_workers(self, tmp_path):
         trials = FileTrials(str(tmp_path / "q"))
         threads, stop = run_workers(str(tmp_path / "q"), n_workers=3)
-        best = fmin(
-            quad_objective, SPACE, algo=rand.suggest, max_evals=20, trials=trials,
-            rstate=np.random.default_rng(0), show_progressbar=False, verbose=False,
-        )
-        stop.set()
+        try:
+            best = fmin(
+                quad_objective, SPACE, algo=rand.suggest, max_evals=20,
+                trials=trials, rstate=np.random.default_rng(0),
+                show_progressbar=False, verbose=False,
+            )
+        finally:
+            stop.set()
         for t in threads:
             t.join(timeout=5)
         assert len(trials) == 20
@@ -165,11 +170,14 @@ class TestFileTrialsFmin:
         qdir = str(tmp_path / "q")
         trials = FileTrials(qdir)
         threads, stop = run_workers(qdir, n_workers=2)
-        fmin(
-            quad_objective, SPACE, algo=rand.suggest, max_evals=10, trials=trials,
-            rstate=np.random.default_rng(0), show_progressbar=False, verbose=False,
-        )
-        stop.set()
+        try:
+            fmin(
+                quad_objective, SPACE, algo=rand.suggest, max_evals=10,
+                trials=trials, rstate=np.random.default_rng(0),
+                show_progressbar=False, verbose=False,
+            )
+        finally:
+            stop.set()
         for t in threads:
             t.join(timeout=5)
         # a brand-new store on the same dir sees everything (Mongo-style
@@ -177,11 +185,14 @@ class TestFileTrialsFmin:
         trials2 = FileTrials(qdir)
         assert len(trials2) == 10
         threads, stop = run_workers(qdir, n_workers=2)
-        fmin(
-            quad_objective, SPACE, algo=rand.suggest, max_evals=15, trials=trials2,
-            rstate=np.random.default_rng(1), show_progressbar=False, verbose=False,
-        )
-        stop.set()
+        try:
+            fmin(
+                quad_objective, SPACE, algo=rand.suggest, max_evals=15,
+                trials=trials2, rstate=np.random.default_rng(1),
+                show_progressbar=False, verbose=False,
+            )
+        finally:
+            stop.set()
         for t in threads:
             t.join(timeout=5)
         assert len(FileTrials(qdir)) == 15
@@ -191,13 +202,15 @@ class TestFileTrialsFmin:
         trials = FileTrials(qdir)
 
         threads, stop = run_workers(qdir, n_workers=2)
-        fmin(
-            flaky_objective, SPACE, algo=rand.suggest, max_evals=12,
-            trials=trials, catch_eval_exceptions=True,
-            rstate=np.random.default_rng(3), show_progressbar=False, verbose=False,
-            return_argmin=False,
-        )
-        stop.set()
+        try:
+            fmin(
+                flaky_objective, SPACE, algo=rand.suggest, max_evals=12,
+                trials=trials, catch_eval_exceptions=True,
+                rstate=np.random.default_rng(3), show_progressbar=False,
+                verbose=False, return_argmin=False,
+            )
+        finally:
+            stop.set()
         for t in threads:
             t.join(timeout=5)
         trials.refresh()
@@ -255,13 +268,15 @@ class TestWorkerCLI:
         trials = FileTrials(qdir)
 
         threads, stop = run_workers(qdir, n_workers=1)
-        fmin(
-            checkpointing_objective, SPACE, algo=rand.suggest, max_evals=2,
-            trials=trials, rstate=np.random.default_rng(0),
-            show_progressbar=False, verbose=False, return_argmin=False,
-            pass_expr_memo_ctrl=None,
-        )
-        stop.set()
+        try:
+            fmin(
+                checkpointing_objective, SPACE, algo=rand.suggest, max_evals=2,
+                trials=trials, rstate=np.random.default_rng(0),
+                show_progressbar=False, verbose=False, return_argmin=False,
+                pass_expr_memo_ctrl=None,
+            )
+        finally:
+            stop.set()
         for t in threads:
             t.join(timeout=5)
         assert len(FileTrials(qdir)) == 2
