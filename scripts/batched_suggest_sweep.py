@@ -1,0 +1,78 @@
+"""Batched-suggest scaling sweep on the live backend.
+
+Measures end-to-end ``tpe.suggest`` throughput (trials/sec) at a
+10k-trial history for several batch sizes k in ONE process, quantifying
+how batching amortizes the per-dispatch overhead (here dominated by the
+bench tunnel's ~80-95 ms RTT; ~100 us on a normal TPU host).  This is
+the production mode of ``JaxTrials(parallelism=k)``: one suggest call
+produces k trials.
+
+Writes one JSON line (commit as BENCH_TPU_batched.json when captured on
+hardware):
+  {"platform": "tpu", "n_history": 10000, "rows":
+    [{"k": 32, "suggests_per_sec": ..., "ms_per_suggest_call": ...}, ...]}
+
+Run:  python scripts/batched_suggest_sweep.py            (TPU via tunnel)
+      BENCH_SWEEP_KS=8,32 python scripts/batched_suggest_sweep.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+KS = tuple(
+    int(x) for x in os.environ.get("BENCH_SWEEP_KS", "8,32,128,512").split(",")
+)
+REPS = int(os.environ.get("BENCH_SWEEP_REPS", 5))
+
+
+def main():
+    import jax
+
+    import bench
+
+    platform = jax.devices()[0].platform
+    domain, trials = bench.build_history_trials()
+    from hyperopt_tpu.algos import tpe
+
+    n_cand = bench.N_EI_CANDIDATES
+    rows = []
+    next_id = bench.N_HISTORY
+    for k in KS:
+        # warm: compile the k-sized batch program outside the timed window
+        ids = list(range(next_id, next_id + k))
+        next_id += k
+        tpe.suggest(ids, domain, trials, 0, n_EI_candidates=n_cand, verbose=False)
+        t0 = time.perf_counter()
+        for r in range(REPS):
+            ids = list(range(next_id, next_id + k))
+            next_id += k
+            tpe.suggest(
+                ids, domain, trials, r + 1, n_EI_candidates=n_cand, verbose=False
+            )
+        per_call = (time.perf_counter() - t0) / REPS
+        rows.append(
+            {
+                "k": k,
+                "suggests_per_sec": round(k / per_call, 2),
+                "ms_per_suggest_call": round(per_call * 1e3, 2),
+            }
+        )
+        print(f"# k={k}: {rows[-1]['suggests_per_sec']}/s", file=sys.stderr)
+
+    out = {
+        "metric": f"tpe_batched_suggests_per_sec_{bench.N_HISTORY}_history",
+        "platform": platform,
+        "n_history": bench.N_HISTORY,
+        "n_EI_candidates": n_cand,
+        "reps_per_k": REPS,
+        "rows": rows,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

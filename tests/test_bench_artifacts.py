@@ -16,7 +16,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TPU = os.path.join(ROOT, "BENCH_TPU.json")
 TPU_100K = os.path.join(ROOT, "BENCH_TPU_100k.json")
 
-pytestmark = pytest.mark.skipif(
+needs_tpu_json = pytest.mark.skipif(
     not os.path.exists(TPU), reason="no committed TPU bench artifact"
 )
 
@@ -26,6 +26,7 @@ def _load(path):
         return json.load(f)
 
 
+@needs_tpu_json
 def test_headline_artifact_is_hardware_and_beats_north_star():
     d = _load(TPU)
     assert d["platform"] == "tpu"
@@ -44,6 +45,26 @@ def test_headline_artifact_is_hardware_and_beats_north_star():
     assert d["suggests_per_sec_batched"] > d["suggests_per_sec_driver_loop"]
 
 
+BATCHED = os.path.join(ROOT, "BENCH_TPU_batched.json")
+
+
+@pytest.mark.skipif(
+    not os.path.exists(BATCHED), reason="no committed batched-sweep artifact"
+)
+def test_batched_suggest_scales_with_k():
+    d = _load(BATCHED)
+    assert d["platform"] == "tpu"
+    rows = sorted(d["rows"], key=lambda r: r["k"])
+    assert len(rows) >= 3
+    rates = [r["suggests_per_sec"] for r in rows]
+    # batching must amortize per-dispatch overhead: monotone non-degrading
+    # throughput in k (10% slack for timing noise) and a real win overall
+    for a, b in zip(rates, rates[1:]):
+        assert b > 0.9 * a, rates
+    assert rates[-1] > 2 * rates[0], rates
+
+
+@needs_tpu_json
 @pytest.mark.skipif(
     not os.path.exists(TPU_100K), reason="no committed 100k artifact"
 )
