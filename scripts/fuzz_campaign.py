@@ -1,0 +1,83 @@
+"""Extended out-of-suite fuzz campaign over the space fuzzers.
+
+The committed suite runs each fuzzer over a handful of seeds (bounded CI
+time); this script loops the same three properties over hundreds of
+FRESH seeds — compiled-vs-interpreted sampler agreement, fmin
+end-to-end survival on arbitrary generated spaces, and mesh-vs-device
+TPE agreement.  Any failure is a real bug with a reproducing seed.
+
+Run (virtual CPU mesh, like the suite):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python scripts/fuzz_campaign.py [N_SEEDS] [SEED_BASE]
+"""
+
+import os
+import sys
+import time
+import traceback
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"
+))
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+BASE = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
+
+
+def main():
+    import jax
+
+    # the axon sitecustomize clobbers JAX_PLATFORMS in every process
+    # (see tests/conftest.py); update the config back before any op
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+    assert jax.devices()[0].platform == "cpu" and len(jax.devices()) >= 8
+
+    from test_space_fuzz import (
+        test_compiled_matches_interpreted_on_random_space as t_sampler,
+        test_fuzzed_space_fmin_end_to_end as t_fmin,
+        test_fuzzed_space_mesh_device_tpe_agree as t_mesh,
+    )
+
+    checks = [("sampler", t_sampler), ("fmin", t_fmin), ("mesh", t_mesh)]
+    failures = []
+    t0 = time.time()
+    for i in range(N):
+        seed = BASE + i
+        for name, fn in checks:
+            try:
+                fn(seed)
+            except Exception:
+                failures.append((name, seed))
+                print(f"FAIL {name} seed={seed}", flush=True)
+                traceback.print_exc()
+        # every seed compiles fresh programs (new space shapes); clear
+        # the in-process executable caches so a long campaign's memory
+        # stays bounded
+        jax.clear_caches()
+        if (i + 1) % 20 == 0:
+            print(
+                f"[{time.time() - t0:.0f}s] {i + 1}/{N} seeds, "
+                f"{len(failures)} failures",
+                flush=True,
+            )
+    print(
+        f"done: {N} seeds x {len(checks)} properties, "
+        f"{len(failures)} failures {failures[:10]}",
+        flush=True,
+    )
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()
