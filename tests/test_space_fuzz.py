@@ -94,6 +94,17 @@ def _counter():
         i += 1
 
 
+def _enough_spread(a):
+    # a variance comparison carries information only if the sample isn't
+    # (nearly) constant: require >=5 observations off the modal value.
+    # Rare-event discrete labels (e.g. a pchoice arm with p~0.06 seen
+    # once in ~119 conditional draws) otherwise inflate the std ratio to
+    # 3x+ on pure binomial noise (campaign seed 20051; agreement
+    # confirmed at 60k draws).
+    _, counts = np.unique(np.round(a, 12), return_counts=True)
+    return len(counts) > 1 and (len(a) - counts.max()) >= 5
+
+
 @pytest.mark.parametrize("seed", range(8))
 def test_compiled_matches_interpreted_on_random_space(seed):
     rng = np.random.default_rng(seed)
@@ -131,11 +142,16 @@ def test_compiled_matches_interpreted_on_random_space(seed):
         assert abs(np.mean(cv) - np.mean(iv)) / scale < 0.5, (
             lb, np.mean(cv), np.mean(iv), scale,
         )
-        if min(np.std(iv), np.std(cv)) > 1e-6:
+        if min(np.std(iv), np.std(cv)) > 1e-6 and _enough_spread(iv):
             # ~100 conditional samples of a heavy-tailed dist put ~10%
             # relative noise on the std estimate; 2.5x bounds still
             # catch any systematic scale error while not flaking at
-            # fuzz-campaign sample counts (2.04 observed benign)
+            # fuzz-campaign sample counts (2.04 observed benign).
+            # The spread guard is deliberately applied ONLY to the small
+            # interpreted sample: on the much larger compiled sample a
+            # (near-)missing minority class is itself the disagreement
+            # signal a rare-arm probability bug would leave, and the
+            # ratio bound must stay armed to catch it.
             ratio = np.std(cv) / np.std(iv)
             assert 0.4 < ratio < 2.5, (lb, np.std(cv), np.std(iv))
 
