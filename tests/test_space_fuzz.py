@@ -143,17 +143,39 @@ def test_compiled_matches_interpreted_on_random_space(seed):
             lb, np.mean(cv), np.mean(iv), scale,
         )
         if min(np.std(iv), np.std(cv)) > 1e-6 and _enough_spread(iv):
-            # ~100 conditional samples of a heavy-tailed dist put ~10%
-            # relative noise on the std estimate; 2.5x bounds still
-            # catch any systematic scale error while not flaking at
-            # fuzz-campaign sample counts (2.04 observed benign).
+            # Scale agreement on a robust estimator: the sample std of a
+            # heavy-tailed dist has O(1) relative noise at n~10^2 (a
+            # doubly-conditional lognormal hit std ratio 0.34 on ~80
+            # interpreted draws at campaign seed 2004 — agreement
+            # confirmed at 50k/20k draws, ratio 1.05), while the IQR's
+            # relative noise at the same n is ~15%.  A systematic sigma
+            # error in either sampler scales the IQR proportionally, so
+            # the check stays armed; std remains the fallback for
+            # (near-)discrete samples whose IQR collapses to 0.
             # The spread guard is deliberately applied ONLY to the small
             # interpreted sample: on the much larger compiled sample a
             # (near-)missing minority class is itself the disagreement
             # signal a rare-arm probability bug would leave, and the
             # ratio bound must stay armed to catch it.
-            ratio = np.std(cv) / np.std(iv)
-            assert 0.4 < ratio < 2.5, (lb, np.std(cv), np.std(iv))
+            # IQR only for samples that look continuous (essentially all
+            # values distinct).  On discrete dists a quartile can sit ON
+            # a probability-mass boundary, where np.percentile's linear
+            # interpolation swings the IQR by a full support gap on one
+            # draw's binomial noise (8.5%/label false-failure rate on a
+            # two-point pchoice in simulation) — while their std is the
+            # zero-noise estimator the old check already handled.
+            def _uniq_frac(a):
+                return len(np.unique(np.round(a, 12))) / len(a)
+
+            if min(_uniq_frac(cv), _uniq_frac(iv)) > 0.9:
+                c_s = float(np.subtract(*np.percentile(cv, [75, 25])))
+                i_s = float(np.subtract(*np.percentile(iv, [75, 25])))
+                est = "iqr"
+            else:
+                c_s, i_s = float(np.std(cv)), float(np.std(iv))
+                est = "std"
+            ratio = c_s / i_s
+            assert 0.4 < ratio < 2.5, (lb, est, ratio, c_s, i_s)
 
 
 @pytest.mark.parametrize("seed", range(8))
