@@ -1,10 +1,16 @@
 """Extended out-of-suite fuzz campaign over the space fuzzers.
 
 The committed suite runs each fuzzer over a handful of seeds (bounded CI
-time); this script loops the same three properties over hundreds of
+time); this script loops the same four properties over hundreds of
 FRESH seeds — compiled-vs-interpreted sampler agreement, fmin
-end-to-end survival on arbitrary generated spaces, and mesh-vs-device
-TPE agreement.  Any failure is a real bug with a reproducing seed.
+end-to-end survival on arbitrary generated spaces, mesh-vs-device
+TPE agreement, and durable-queue concurrency invariants (random worker
+counts/latencies/failure rates; exactly-once, no lost docs).  A failure
+of the first three properties is a real bug with a deterministically
+reproducing seed; the queue property races real worker threads, so its
+seed fixes the workload but not the interleaving — treat a queue
+failure as a real finding to chase with the logs it printed, even if
+the seed passes on replay.
 
 Run (virtual CPU mesh, like the suite):
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -43,13 +49,19 @@ def main():
         pass
     assert jax.devices()[0].platform == "cpu" and len(jax.devices()) >= 8
 
+    from test_file_trials import test_fuzzed_filetrials_concurrency as t_queue
     from test_space_fuzz import (
         test_compiled_matches_interpreted_on_random_space as t_sampler,
         test_fuzzed_space_fmin_end_to_end as t_fmin,
         test_fuzzed_space_mesh_device_tpe_agree as t_mesh,
     )
 
-    checks = [("sampler", t_sampler), ("fmin", t_fmin), ("mesh", t_mesh)]
+    checks = [
+        ("sampler", t_sampler),
+        ("fmin", t_fmin),
+        ("mesh", t_mesh),
+        ("queue", t_queue),
+    ]
     failures = []
     t0 = time.time()
     for i in range(N):

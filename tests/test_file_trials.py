@@ -523,3 +523,81 @@ jobs.write(doc)
         self._assert_recovers_exactly_once(jobs, qdir)
         # the orphaned tmp file (if any) must not confuse the queue scan
         assert jobs.count_states()[JOB_STATE_DONE] == 1
+
+
+# ---------------------------------------------------------------------
+# Randomized concurrency property (fuzz-campaign property 4)
+# ---------------------------------------------------------------------
+
+_chaos_counts = {}
+_chaos_counts_lock = threading.Lock()
+
+
+def chaos_objective(cfg):
+    """Random-latency, randomly-failing objective that records how many
+    times each sampled point was evaluated (uid = the x draw, unique per
+    trial with probability 1 under a continuous dist)."""
+    uid = round(float(cfg["x"]), 9)
+    with _chaos_counts_lock:
+        _chaos_counts[uid] = _chaos_counts.get(uid, 0) + 1
+    time.sleep(float(cfg["sleep_ms"]) / 1000.0)
+    if cfg["fail"]:
+        raise RuntimeError("chaos failure")
+    return (float(cfg["x"]) - 1.0) ** 2
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_fuzzed_filetrials_concurrency(seed):
+    """Randomized end-to-end stress of the durable queue: random worker
+    count, per-trial latency, and failure rate.  Invariants: no doc is
+    lost, every doc reaches a terminal state exactly once (double
+    evaluation would be a reservation-exclusivity bug; the SIGKILL tier
+    covers crashed-worker recovery separately), failures carry their
+    error annotation, and successes carry a finite loss.  Also looped
+    over hundreds of fresh seeds by scripts/fuzz_campaign.py."""
+    import tempfile
+
+    rng = np.random.default_rng(10_000 + seed)
+    n_workers = int(rng.integers(1, 5))
+    n_trials = int(rng.integers(8, 21))
+    fail_p = float(rng.uniform(0.0, 0.35))
+    max_sleep_ms = float(rng.choice([5.0, 30.0, 80.0]))
+    space = {
+        "x": hp.uniform("x", -5, 5),
+        "sleep_ms": hp.uniform("sleep_ms", 0.0, max_sleep_ms),
+        "fail": hp.pchoice("fail", [(1.0 - fail_p, 0), (fail_p, 1)]),
+    }
+
+    with _chaos_counts_lock:
+        _chaos_counts.clear()
+    with tempfile.TemporaryDirectory() as td:
+        qdir = os.path.join(td, "q")
+        trials = FileTrials(qdir)
+        threads, stop = run_workers(qdir, n_workers=n_workers)
+        try:
+            fmin(
+                chaos_objective, space, algo=rand.suggest,
+                max_evals=n_trials, trials=trials,
+                catch_eval_exceptions=True,
+                rstate=np.random.default_rng(seed),
+                show_progressbar=False, verbose=False, return_argmin=False,
+            )
+        finally:
+            stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        trials.refresh()
+        docs = trials._dynamic_trials
+        assert len(docs) == n_trials, (len(docs), n_trials)
+        assert len({d["tid"] for d in docs}) == n_trials
+        for d in docs:
+            assert d["state"] in (JOB_STATE_DONE, JOB_STATE_ERROR), d["tid"]
+            if d["state"] == JOB_STATE_DONE:
+                assert np.isfinite(d["result"]["loss"])
+            else:
+                assert "chaos failure" in d["misc"]["error"][1]
+            assert d["owner"] is not None
+        with _chaos_counts_lock:
+            assert len(_chaos_counts) == n_trials
+            multi = {u: c for u, c in _chaos_counts.items() if c != 1}
+        assert not multi, f"trials evaluated more than once: {multi}"

@@ -171,6 +171,19 @@ def test_compiled_matches_interpreted_on_random_space(seed):
                 c_s = float(np.subtract(*np.percentile(cv, [75, 25])))
                 i_s = float(np.subtract(*np.percentile(iv, [75, 25])))
                 est = "iqr"
+                # The IQR is blind to rare-outlier corruption (a sampler
+                # bug emitting junk in 1% of draws leaves the quartiles
+                # untouched, and the mean check's std-based scale
+                # self-normalizes the same junk away).  Catastrophic-tail
+                # tripwire: the widest legitimate generated dist
+                # (lognormal sigma<=1, loguniform span<=3) keeps
+                # max|x-median|/IQR well under 10^2 at these n, so 10^4
+                # only ever trips on genuinely corrupted values.
+                for side, a, s in (("compiled", cv, c_s), ("interp", iv, i_s)):
+                    med = float(np.median(a))
+                    tail = float(np.max(np.abs(a - med)))
+                    cap = 1e4 * max(s, 1e-3, 0.1 * abs(med))
+                    assert tail <= cap, (lb, side, "tail", tail, cap)
             else:
                 c_s, i_s = float(np.std(cv)), float(np.std(iv))
                 est = "std"
