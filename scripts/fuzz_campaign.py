@@ -18,6 +18,7 @@ Run (virtual CPU mesh, like the suite):
 """
 
 import os
+import signal
 import sys
 import time
 import traceback
@@ -62,13 +63,31 @@ def main():
         ("mesh", t_mesh),
         ("queue", t_queue),
     ]
+
+    def run_with_watchdog(fn, seed, limit=600):
+        """A wedged check (the queue property's primary failure mode is
+        an fmin poll-loop deadlock) must surface as a recorded FAIL, not
+        stall the campaign silently.  SIGALRM only interrupts the main
+        thread at a bytecode boundary — enough for sleep/poll loops,
+        which is exactly the deadlock shape being guarded against."""
+
+        def on_alarm(signum, frame):
+            raise TimeoutError(f"check exceeded {limit}s (deadlock?)")
+
+        old = signal.signal(signal.SIGALRM, on_alarm)
+        signal.alarm(limit)
+        try:
+            fn(seed)
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
     failures = []
     t0 = time.time()
     for i in range(N):
         seed = BASE + i
         for name, fn in checks:
             try:
-                fn(seed)
+                run_with_watchdog(fn, seed)
             except Exception:
                 failures.append((name, seed))
                 print(f"FAIL {name} seed={seed}", flush=True)

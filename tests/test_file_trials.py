@@ -583,9 +583,13 @@ def test_fuzzed_filetrials_concurrency(seed):
                 show_progressbar=False, verbose=False, return_argmin=False,
             )
         finally:
+            # join INSIDE the finally: if fmin raises (e.g. the campaign
+            # watchdog's TimeoutError), live workers must be drained
+            # before TemporaryDirectory cleanup, or rmtree races their
+            # in-flight writes and masks the original failure
             stop.set()
-        for t in threads:
-            t.join(timeout=10)
+            for t in threads:
+                t.join(timeout=10)
         trials.refresh()
         docs = trials._dynamic_trials
         assert len(docs) == n_trials, (len(docs), n_trials)

@@ -11,6 +11,8 @@ hand-built spaces; the generator covers the combinatorial shapes no
 hand-written list reaches).
 """
 
+import zlib
+
 import numpy as np
 import pytest
 
@@ -143,52 +145,64 @@ def test_compiled_matches_interpreted_on_random_space(seed):
             lb, np.mean(cv), np.mean(iv), scale,
         )
         if min(np.std(iv), np.std(cv)) > 1e-6 and _enough_spread(iv):
-            # Scale agreement on a robust estimator: the sample std of a
-            # heavy-tailed dist has O(1) relative noise at n~10^2 (a
-            # doubly-conditional lognormal hit std ratio 0.34 on ~80
-            # interpreted draws at campaign seed 2004 — agreement
-            # confirmed at 50k/20k draws, ratio 1.05), while the IQR's
-            # relative noise at the same n is ~15%.  A systematic sigma
-            # error in either sampler scales the IQR proportionally, so
-            # the check stays armed; std remains the fallback for
-            # (near-)discrete samples whose IQR collapses to 0.
+            # Scale agreement via a PERMUTATION test on the std ratio.
+            # Any fixed ratio bound on a scalar estimator is wrong for
+            # some distribution shape at these sample sizes: the plain
+            # std has O(1) relative noise on heavy tails (lognormal hit
+            # ratio 0.34 at campaign seed 2004, quantized lognormal 0.28
+            # at seed 2105 — both in agreement at 50k/12k+ draws), the
+            # IQR swings by a support gap when a quartile sits on a
+            # discrete mass boundary, and a winsorized std clips a
+            # rare-but-variance-dominant discrete arm asymmetrically
+            # between the two sample sizes.  Resampling the POOLED
+            # sample at the two observed sizes builds the null
+            # distribution of log(std_c/std_i) for THIS shape and THESE
+            # n, so the acceptance region widens exactly where the
+            # estimator is legitimately noisy and stays tight where it
+            # is not — a systematic sigma error shifts the observed
+            # ratio off a null that is centered by construction.
             # The spread guard is deliberately applied ONLY to the small
             # interpreted sample: on the much larger compiled sample a
             # (near-)missing minority class is itself the disagreement
             # signal a rare-arm probability bug would leave, and the
-            # ratio bound must stay armed to catch it.
-            # IQR only for samples that look continuous (essentially all
-            # values distinct).  On discrete dists a quartile can sit ON
-            # a probability-mass boundary, where np.percentile's linear
-            # interpolation swings the IQR by a full support gap on one
-            # draw's binomial noise (8.5%/label false-failure rate on a
-            # two-point pchoice in simulation) — while their std is the
-            # zero-noise estimator the old check already handled.
-            def _uniq_frac(a):
-                return len(np.unique(np.round(a, 12))) / len(a)
+            # check must stay armed to catch it.
+            obs = float(np.log(np.std(cv) / np.std(iv)))
+            pooled = np.concatenate([cv, iv])
+            # zlib.crc32, not hash(): str hash is randomized per process
+            prng = np.random.default_rng(
+                [seed, len(pooled), zlib.crc32(lb.encode())]
+            )
+            null = []
+            for _ in range(300):
+                idx = prng.permutation(len(pooled))
+                sa = np.std(pooled[idx[: len(cv)]])
+                sb = np.std(pooled[idx[len(cv):]])
+                if sa > 1e-12 and sb > 1e-12:
+                    null.append(np.log(sa / sb))
+            if len(null) >= 100:
+                lo_q, hi_q = np.quantile(null, [0.001, 0.999])
+                # 0.15 absolute log-margin (~1.16x) absorbs the null
+                # quantiles' own Monte-Carlo error at 300 resamples
+                assert lo_q - 0.15 <= obs <= hi_q + 0.15, (
+                    lb, "perm", obs, lo_q, hi_q,
+                )
+            # The permutation null is blind to corruption present in
+            # BOTH pooled halves, and the mean check's std-based scale
+            # self-normalizes extreme junk away, so corrupted-tail
+            # draws get their own tripwire: the widest legitimate
+            # generated dist (lognormal sigma<=1, loguniform span<=3)
+            # keeps max|x-median|/scale well under 10^2 at these n, so
+            # 10^4 only ever trips on genuinely corrupt values.
+            def _wscale(a):
+                lo, hi = np.percentile(a, [2, 98])
+                s = float(np.std(np.clip(a, lo, hi)))
+                return s if s > 1e-9 else float(np.std(a))
 
-            if min(_uniq_frac(cv), _uniq_frac(iv)) > 0.9:
-                c_s = float(np.subtract(*np.percentile(cv, [75, 25])))
-                i_s = float(np.subtract(*np.percentile(iv, [75, 25])))
-                est = "iqr"
-                # The IQR is blind to rare-outlier corruption (a sampler
-                # bug emitting junk in 1% of draws leaves the quartiles
-                # untouched, and the mean check's std-based scale
-                # self-normalizes the same junk away).  Catastrophic-tail
-                # tripwire: the widest legitimate generated dist
-                # (lognormal sigma<=1, loguniform span<=3) keeps
-                # max|x-median|/IQR well under 10^2 at these n, so 10^4
-                # only ever trips on genuinely corrupted values.
-                for side, a, s in (("compiled", cv, c_s), ("interp", iv, i_s)):
-                    med = float(np.median(a))
-                    tail = float(np.max(np.abs(a - med)))
-                    cap = 1e4 * max(s, 1e-3, 0.1 * abs(med))
-                    assert tail <= cap, (lb, side, "tail", tail, cap)
-            else:
-                c_s, i_s = float(np.std(cv)), float(np.std(iv))
-                est = "std"
-            ratio = c_s / i_s
-            assert 0.4 < ratio < 2.5, (lb, est, ratio, c_s, i_s)
+            for side, a in (("compiled", cv), ("interp", iv)):
+                med = float(np.median(a))
+                tail = float(np.max(np.abs(a - med)))
+                cap = 1e4 * max(_wscale(a), 1e-3, 0.1 * abs(med))
+                assert tail <= cap, (lb, side, "tail", tail, cap)
 
 
 @pytest.mark.parametrize("seed", range(8))
