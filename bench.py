@@ -403,7 +403,33 @@ def _device_scorer_bench(rtt, cap_b, platform):
     return out, headline
 
 
+def wallclock_section(argv):
+    """``python bench.py --wallclock [--quick]``: the wall-clock-to-target
+    benchmark for the pipelined suggest engine (BASELINE.md's
+    "wall-clock-to-equal-quality" metric).  Delegates to
+    scripts/bench_walltime.py, which writes BENCH_WALLCLOCK.json; this
+    entry point exists so every committed BENCH_*.json artifact is
+    reproducible through bench.py."""
+    scripts_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"
+    )
+    sys.path.insert(0, scripts_dir)
+    try:
+        import bench_walltime
+    finally:
+        # remove by value: bench_walltime itself prepends the repo root
+        # at import time, so pop(0) would strip the wrong entry
+        try:
+            sys.path.remove(scripts_dir)
+        except ValueError:
+            pass
+    return bench_walltime.main(argv)
+
+
 def main():
+    if "--wallclock" in sys.argv[1:]:
+        argv = [a for a in sys.argv[1:] if a != "--wallclock"]
+        return wallclock_section(argv)
     _ensure_live_backend()
     t_setup = time.time()
     import jax

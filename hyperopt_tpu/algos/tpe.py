@@ -496,10 +496,27 @@ def _suggest_device(
     param_locks,
     trial_filter,
     mesh=None,
+    defer=False,
+    pending=None,
 ):
     """The production suggest path: device-resident history, one fused XLA
     program per distribution family, O(k) host↔device traffic per call
     (see :mod:`hyperopt_tpu.algos.tpe_device`).
+
+    ``defer=True`` launches the fused device program WITHOUT the blocking
+    readback and returns a zero-arg resolver producing the trial docs —
+    the async-dispatch handle the pipelined suggest engine overlaps with
+    objective evaluation.
+
+    ``pending`` (a list of in-flight trials' ``misc["vals"]`` dicts, in
+    completion order) makes the fit run against the HYPOTHETICAL history
+    in which each pending trial has completed with a worst-case loss —
+    the lands-above branch prediction (``DeviceHistory
+    .hypothetical_append``): their known parameter vectors join g(x),
+    ``n_below`` is computed for the grown count, and when a pending
+    result really does land in the above set the suggestion equals the
+    post-completion serial one exactly.  Incompatible with
+    ``trial_filter`` (the filter indexes the real history).
 
     With ``mesh``, the SAME path runs with the history buffers replicated
     on the mesh and the O(C·K) scoring sharded across it (candidates over
@@ -517,6 +534,8 @@ def _suggest_device(
     dh = td.device_history_for(trials, domain.space, mesh=mesh)
     dh.sync(hist)
 
+    if pending and trial_filter is not None:
+        raise ValueError("pending speculation is incompatible with trial_filter")
     mask = None
     if trial_filter is not None:
         mask = trial_filter(hist) if callable(trial_filter) else trial_filter
@@ -527,12 +546,18 @@ def _suggest_device(
             )
         if not mask.any():
             mask = None
-    n_eff = int(mask.sum()) if mask is not None else len(hist.losses)
+    n_pending = len(pending) if pending else 0
+    n_eff = int(mask.sum()) if mask is not None else len(hist.losses) + n_pending
     n_below = int(np.ceil(gamma * np.sqrt(n_eff)))
     if linear_forgetting is not None:  # ap_split_trials gamma_cap semantics
         n_below = min(n_below, int(linear_forgetting))
     cap_b = parzen_ops.bucket(max(n_below, 1))
-    keep_mask = dh.keep_mask(mask)
+    if pending:
+        losses_buf, hyp_views, keep_mask = dh.hypothetical_append(
+            hist, list(pending)
+        )
+    else:
+        losses_buf, hyp_views, keep_mask = dh.losses, {}, dh.keep_mask(mask)
 
     label_keys = _host_label_keys(int(seed), dh.n_labels)
     # mesh mode replaces the single-device pair scorer with the sharded
@@ -552,9 +577,11 @@ def _suggest_device(
                 else:
                     hard[lb] = np.full(k, float(center), np.float64)
 
-    chosen_vals = {}
     requests, req_fams = [], []  # all families -> ONE device program
     for fam in dh.families.values():
+        f_obs, f_pos, f_counts = hyp_views.get(
+            fam.key, (fam.obs, fam.pos, fam.counts)
+        )
         keys = label_keys[fam.kis]
         lock_c = np.zeros(fam.L, np.float32)
         lock_r = np.full(fam.L, np.inf, np.float32)
@@ -582,7 +609,7 @@ def _suggest_device(
             requests.append((
                 "cont",
                 (
-                    keys, fam.obs, fam.pos, fam.counts, dh.losses,
+                    keys, f_obs, f_pos, f_counts, losses_buf,
                     keep_mask, np.int32(n_below), np.float32(prior_weight),
                     priors, lock_c, lock_r,
                 ),
@@ -607,7 +634,7 @@ def _suggest_device(
             requests.append((
                 "idx",
                 (
-                    keys, fam.obs, fam.pos, fam.counts, dh.losses,
+                    keys, f_obs, f_pos, f_counts, losses_buf,
                     keep_mask, np.int32(n_below), np.float32(prior_weight),
                     fam.prior_p, lock_c, lock_r,
                 ),
@@ -621,14 +648,21 @@ def _suggest_device(
     # flat readback: per-dispatch latency (a network round trip when the
     # chip is tunneled) is paid once per suggest, not once per family,
     # and XLA CSE's the shared loss-ranks argsort across families
-    fetched = td.multi_family_suggest(requests)
-    for fam, best in zip(req_fams, fetched):
-        best = np.asarray(best)  # [L, k]
-        for i, lb in enumerate(fam.labels):
-            if lb not in hard:
-                chosen_vals[lb] = fam.from_fit_space(i, best[i])
-    chosen_vals.update(hard)
-    return _emit_docs(new_ids, domain, trials, chosen_vals, k)
+    resolve_fetch = td.multi_family_suggest_async(requests)
+
+    def finish():
+        chosen_vals = {}
+        for fam, best in zip(req_fams, resolve_fetch()):
+            best = np.asarray(best)  # [L, k]
+            for i, lb in enumerate(fam.labels):
+                if lb not in hard:
+                    chosen_vals[lb] = fam.from_fit_space(i, best[i])
+        chosen_vals.update(hard)
+        return _emit_docs(new_ids, domain, trials, chosen_vals, k)
+
+    if defer:
+        return finish
+    return finish()
 
 
 def suggest(
@@ -677,8 +711,61 @@ def suggest(
     restricts which completed trials feed the posterior (the reference's
     ``resultFilteringMode`` observation filtering).
     """
-    import jax
+    out = _suggest_impl(
+        new_ids, domain, trials, seed, prior_weight, n_startup_jobs,
+        n_EI_candidates, gamma, linear_forgetting, param_locks,
+        trial_filter, mesh, defer=False,
+    )
+    return out
 
+
+def suggest_async(
+    new_ids,
+    domain,
+    trials,
+    seed,
+    prior_weight=_default_prior_weight,
+    n_startup_jobs=_default_n_startup_jobs,
+    n_EI_candidates=_default_n_EI_candidates,
+    gamma=_default_gamma,
+    linear_forgetting=_default_linear_forgetting,
+    verbose=True,
+    mesh=None,
+    param_locks=None,
+    trial_filter=None,
+    pending=None,
+):
+    """Asynchronous-dispatch TPE suggest: same semantics and signature as
+    :func:`suggest`, but the fused device program is LAUNCHED without its
+    blocking readback and a zero-arg resolver is returned.  Calling the
+    resolver yields exactly the trial docs ``suggest`` would have returned
+    for the same inputs; the device computes in the background in between.
+
+    This is the dispatch layer the pipelined suggest engine
+    (:mod:`hyperopt_tpu.pipeline`) uses to hide suggest latency behind
+    objective evaluation.  The random-search startup phase and the
+    uncompilable-space fallback are history-independent and computed
+    eagerly (their resolver is a constant).
+
+    ``pending``: in-flight trials' ``misc["vals"]`` dicts, completion
+    order.  The fit then runs against the hypothetical history in which
+    each pending trial completed with a worst-case loss (the lands-above
+    branch prediction; see :func:`_suggest_device`) — when a pending
+    result really lands in the above set, the deferred docs equal the
+    post-completion serial suggest bit-for-bit.
+    """
+    return _suggest_impl(
+        new_ids, domain, trials, seed, prior_weight, n_startup_jobs,
+        n_EI_candidates, gamma, linear_forgetting, param_locks,
+        trial_filter, mesh, defer=True, pending=pending,
+    )
+
+
+def _suggest_impl(
+    new_ids, domain, trials, seed, prior_weight, n_startup_jobs,
+    n_EI_candidates, gamma, linear_forgetting, param_locks, trial_filter,
+    mesh, defer, pending=None,
+):
     hist = trials.history
     # Startup gate on ALL inserted non-error trials (reference semantics:
     # ``len(trials.trials)``), not completed-OK count — with async backends
@@ -686,14 +773,16 @@ def suggest(
     # the reference does.  A separate guard keeps random suggest while the
     # OK history is empty (nothing to fit a posterior on).
     if len(trials.trials) < n_startup_jobs or len(hist.losses) == 0:
-        return rand.suggest(new_ids, domain, trials, seed)
+        docs = rand.suggest(new_ids, domain, trials, seed)
+        return (lambda: docs) if defer else docs
 
     if not domain.space.compiled:
         logger.warning(
             "space not compilable (%s): tpe falling back to random suggest",
             domain.space.compile_error,
         )
-        return rand.suggest(new_ids, domain, trials, seed)
+        docs = rand.suggest(new_ids, domain, trials, seed)
+        return (lambda: docs) if defer else docs
 
     # one unified path: device-resident history + fused multi-family
     # programs; with a mesh the scoring inside those programs shards
@@ -712,4 +801,13 @@ def suggest(
         param_locks,
         trial_filter,
         mesh=mesh,
+        defer=defer,
+        pending=pending,
     )
+
+
+# the pipelined suggest engine discovers the async dispatch variant (and
+# the speculation-validity policy) through these attributes — a plugin
+# contract any suggest algorithm can opt into (see hyperopt_tpu.pipeline)
+suggest.async_variant = suggest_async
+suggest.speculation_policy = "tpe_quantile"

@@ -282,26 +282,36 @@ class DeviceHistory:
         self._n_synced = n
 
         for fam in self.families.values():
-            counts = []
-            cols = {}
-            for i, label in enumerate(fam.labels):
-                tids = hist.idxs.get(label, ())
-                vals = hist.vals.get(label, ())
-                counts.append(len(tids))
-                cols[i] = (tids, vals)
+            counts = [
+                len(hist.idxs.get(label, ())) for label in fam.labels
+            ]
             fam.cap = parzen_ops.bucket(max(max(counts, default=0), 1))
-            obs = np.zeros((fam.L, fam.cap), np.float32)
-            pos = np.zeros((fam.L, fam.cap), np.int32)
-            for i in range(fam.L):
-                tids, vals = cols[i]
-                c = len(tids)
-                if c:
-                    obs[i, :c] = fam.to_fit_space(i, vals)
-                    pos[i, :c] = [self._tid_row[int(t)] for t in tids]
+            obs, pos, counts = self._host_family_arrays(fam, hist, fam.cap)
             fam.counts_host = counts
             fam.obs = self._upload(obs)
             fam.pos = self._upload(pos)
             fam.counts = self._upload(np.asarray(counts, np.int32))
+
+    def _host_family_arrays(self, fam, hist, cap):
+        """One family's (obs, pos, counts) HOST arrays reconstructed from
+        ``hist`` at capacity ``cap`` — the single source of truth for the
+        full-rebuild layout, shared by ``_rebuild`` and the hypothetical
+        bucket-boundary path (which must mirror the future real rebuild
+        exactly or the bit-for-bit k=1 guarantee breaks precisely at
+        power-of-two history boundaries).  Requires ``self._tid_row`` to
+        be current for ``hist``."""
+        obs = np.zeros((fam.L, cap), np.float32)
+        pos = np.zeros((fam.L, cap), np.int32)
+        counts = []
+        for i, label in enumerate(fam.labels):
+            tids = hist.idxs.get(label, ())
+            vals = hist.vals.get(label, ())
+            c = len(tids)
+            if c:
+                obs[i, :c] = fam.to_fit_space(i, vals)
+                pos[i, :c] = [self._tid_row[int(t)] for t in tids]
+            counts.append(c)
+        return obs, pos, counts
 
     def _append(self, hist):
         n = len(hist.losses)
@@ -365,14 +375,121 @@ class DeviceHistory:
             fam.obs, fam.pos, fam.counts = obs, pos, counts
 
 
+    def hypothetical_append(self, hist, pending_vals):
+        """A one-trial-ahead VIEW of the device history: the synced
+        buffers plus the pending trials' observations appended, each
+        carrying a worst-case ``+BIG`` loss — the "lands in the above
+        set" branch prediction of the speculative suggest engine
+        (:mod:`hyperopt_tpu.pipeline`).
+
+        A pending trial's parameter vector is fully known while its
+        objective runs; only its loss is not.  The loss affects the TPE
+        fit solely through γ-split *membership*, and ``+BIG`` ranks
+        after every real loss (stable sort, before nothing — padding
+        ties resolve by row order), so a suggest computed against this
+        view with ``n_below`` for the grown count is EXACTLY the
+        suggest the serial loop computes after a completion that lands
+        above.  ``pending_vals``: list of per-trial ``misc["vals"]``
+        dicts, in completion-row order.
+
+        Non-destructive: the live buffers are neither donated nor
+        mutated and this DeviceHistory's host state is untouched (the
+        next real ``sync`` proceeds as if this was never called).
+        Returns ``(losses, fam_views, keep_mask)``; ``fam_views`` maps
+        family key → ``(obs, pos, counts)`` device arrays for families
+        that gained observations — others read their live buffers.
+        Must be called with ``self`` already synced to ``hist``.
+        """
+        n0 = self._n_synced
+        n1 = n0 + len(pending_vals)
+
+        fam_extra = {}  # fam -> (rows, cols, vals, poss, new_counts)
+        overflow = n1 > self.capt
+        for fam in self.families.values():
+            rows, cols, vals, poss = [], [], [], []
+            counts = list(fam.counts_host)
+            for j, pv in enumerate(pending_vals):
+                for i, label in enumerate(fam.labels):
+                    v = pv.get(label, ())
+                    if len(v):
+                        rows.append(i)
+                        cols.append(counts[i])
+                        vals.append(
+                            float(fam.to_fit_space(i, np.asarray(v))[0])
+                        )
+                        poss.append(n0 + j)
+                        counts[i] += 1
+            if rows:
+                fam_extra[fam] = (rows, cols, vals, poss, counts)
+                if max(counts) > fam.cap:
+                    overflow = True
+
+        if overflow:
+            return self._hypothetical_rebuild(hist, pending_vals, fam_extra)
+
+        d = _delta_bucket(n1 - n0)
+        idx = np.full(d, self.capt, np.int32)
+        lvals = np.zeros(d, np.float32)
+        idx[: n1 - n0] = np.arange(n0, n1)
+        lvals[: n1 - n0] = _BIG
+        changed, fam_deltas = [], []
+        for fam, (rows, cols, vals, poss, counts) in fam_extra.items():
+            d = _delta_bucket(len(rows))
+            r = np.full(d, fam.L, np.int32)
+            c = np.zeros(d, np.int32)
+            v = np.zeros(d, np.float32)
+            p = np.zeros(d, np.int32)
+            r[: len(rows)] = rows
+            c[: len(rows)] = cols
+            v[: len(rows)] = vals
+            p[: len(rows)] = poss
+            changed.append(fam)
+            fam_deltas.append((r, c, v, p, np.asarray(counts, np.int32)))
+        state = (self.losses, [(f.obs, f.pos) for f in changed])
+        losses, fam_out = _apply_all_deltas_preserve(
+            state, idx, lvals, fam_deltas
+        )
+        views = {
+            fam.key: out for fam, out in zip(changed, fam_out)
+        }
+        return losses, views, self.keep_mask(None)
+
+    def _hypothetical_rebuild(self, hist, pending_vals, fam_extra):
+        """Bucket-boundary fallback for :meth:`hypothetical_append`: the
+        grown history would not fit the live buffers, so build the view
+        host-side at the grown bucket sizes (exactly the shapes the
+        future real ``_rebuild`` will use) and upload it — O(history)
+        once per power-of-two boundary, like the real rebuild."""
+        n0 = self._n_synced
+        n1 = n0 + len(pending_vals)
+        capt = parzen_ops.bucket(max(n1, 1))
+        buf = np.full(capt, _BIG, np.float32)
+        buf[:n0] = hist.losses
+        buf[n0:n1] = _BIG
+        losses = self._upload(buf)
+        views = {}
+        for fam, (rows, cols, vals, poss, counts) in fam_extra.items():
+            cap = parzen_ops.bucket(max(max(counts, default=0), 1))
+            obs, pos, _ = self._host_family_arrays(fam, hist, cap)
+            for r, c, v, p in zip(rows, cols, vals, poss):
+                obs[r, c] = v
+                pos[r, c] = p
+            views[fam.key] = (
+                self._upload(obs),
+                self._upload(pos),
+                self._upload(np.asarray(counts, np.int32)),
+            )
+        ones = np.ones(capt, bool)
+        return losses, views, self._upload(ones)
+
+
 def _delta_bucket(n: int) -> int:
     """Pad scatter deltas to small power-of-two sizes so the jitted append
     programs are reused across calls (suggest batch size varies)."""
     return max(4, 1 << (max(n, 1) - 1).bit_length())
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def _apply_all_deltas(state, loss_idx, loss_vals, fam_deltas):
+def _deltas_body(state, loss_idx, loss_vals, fam_deltas):
     """ONE device program for a whole history append: the loss scatter
     plus every changed family's (obs, pos) scatter and counts refresh.
 
@@ -391,6 +508,13 @@ def _apply_all_deltas(state, loss_idx, loss_vals, fam_deltas):
         pos = pos.at[r, c].set(p, mode="drop")
         out.append((obs, pos, counts))
     return losses, out
+
+
+# the real sync path donates (the old buffers are dead after an append);
+# the hypothetical-append path must NOT (the speculative suggest reads a
+# one-trial-ahead view while the real buffers stay live for the next sync)
+_apply_all_deltas = partial(jax.jit, donate_argnums=(0,))(_deltas_body)
+_apply_all_deltas_preserve = jax.jit(_deltas_body)
 
 
 _cache = weakref.WeakKeyDictionary()
@@ -670,17 +794,20 @@ def _index_family_suggest_core(
 _jit_cache = {}
 
 
-def multi_family_suggest(requests):
-    """ALL families of one suggest as ONE jitted device program.
+def multi_family_suggest_async(requests):
+    """Launch ALL families of one suggest as ONE jitted device program,
+    WITHOUT the blocking readback.
 
-    ``requests``: list of ``(kind, args, statics)`` with kind "cont" or
-    "idx".  Returns the per-family winner arrays in order.  One dispatch
-    and ONE flat [Σ L·k] f32 output (split host-side) instead of one
-    program + one readback per family — per-dispatch/-transfer cost is a
-    network round trip when the chip sits behind a tunnel — and XLA
-    CSE's the loss-rank argsort the family cores share.  (Index winners
-    ride the f32 concat exactly: category indices are tiny integers,
-    far inside f32's 2^24 exact-integer range.)"""
+    Same contract as :func:`multi_family_suggest`, but returns a zero-arg
+    resolver instead of the arrays: JAX's async dispatch means the device
+    program is already running when this function returns, and calling the
+    resolver blocks only for whatever compute is still outstanding and the
+    single flat transfer.  This is the primitive the pipelined suggest
+    engine (:mod:`hyperopt_tpu.pipeline`) overlaps with objective
+    evaluation.  Safe against later history appends: per-device program
+    order guarantees an in-flight suggest reads the pre-append buffers
+    even though ``_apply_all_deltas`` donates them.
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -707,10 +834,29 @@ def multi_family_suggest(requests):
 
         fn = jax.jit(run)
         _jit_cache[("multi",) + sig] = fn
-    flat = np.asarray(fn([args for _, args, _ in requests]))
-    outs, off = [], 0
-    for kind, args, st in requests:
-        L, k = args[0].shape[0], st["k"]
-        outs.append(flat[off : off + L * k].reshape(L, k))
-        off += L * k
-    return outs
+    flat_dev = fn([args for _, args, _ in requests])
+
+    def resolve():
+        flat = np.asarray(flat_dev)  # the ONE blocking readback
+        outs, off = [], 0
+        for kind, args, st in requests:
+            L, k = args[0].shape[0], st["k"]
+            outs.append(flat[off : off + L * k].reshape(L, k))
+            off += L * k
+        return outs
+
+    return resolve
+
+
+def multi_family_suggest(requests):
+    """ALL families of one suggest as ONE jitted device program.
+
+    ``requests``: list of ``(kind, args, statics)`` with kind "cont" or
+    "idx".  Returns the per-family winner arrays in order.  One dispatch
+    and ONE flat [Σ L·k] f32 output (split host-side) instead of one
+    program + one readback per family — per-dispatch/-transfer cost is a
+    network round trip when the chip sits behind a tunnel — and XLA
+    CSE's the loss-rank argsort the family cores share.  (Index winners
+    ride the f32 concat exactly: category indices are tiny integers,
+    far inside f32's 2^24 exact-integer range.)"""
+    return multi_family_suggest_async(requests)()

@@ -708,6 +708,7 @@ class Trials:
         early_stop_fn=None,
         trials_save_file="",
         points_to_evaluate=None,
+        max_speculation=None,
     ):
         """Minimize ``fn`` over ``space`` using this store (see ``fmin``)."""
         from .fmin import fmin as _fmin  # local import: avoid circularity
@@ -731,6 +732,7 @@ class Trials:
             show_progressbar=show_progressbar,
             early_stop_fn=early_stop_fn,
             trials_save_file=trials_save_file,
+            max_speculation=max_speculation,
         )
 
 

@@ -14,6 +14,7 @@ the reference's Spark/Mongo paths.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import pickle
@@ -42,6 +43,16 @@ from .utils import coarse_utcnow
 from .vectorize import CompiledSpace
 
 logger = logging.getLogger(__name__)
+
+# Default speculation depth for the pipelined suggest engine (see
+# hyperopt_tpu.pipeline): while the objective for trial t evaluates in a
+# worker thread, the device suggest program for trial t+1..t+k runs
+# speculatively against the current history.  0 = the strictly serial
+# loop (suggest and evaluate times add).  Overridable per call via
+# ``fmin(max_speculation=...)`` or globally via the env var — read at
+# call time, so setting it after import still takes effect.
+def _default_max_speculation():
+    return int(os.environ.get("HYPEROPT_MAX_SPECULATION", "1"))
 
 
 def fmin_pass_expr_memo_ctrl(f):
@@ -106,10 +117,15 @@ class FMinIter:
         early_stop_fn=None,
         trials_save_file="",
         orbax_ckpt=None,
+        max_speculation=None,
     ):
         self.algo = algo
         self.domain = domain
         self.trials = trials
+        if max_speculation is None:
+            max_speculation = _default_max_speculation()
+        self.max_speculation = max_speculation
+        self._engine = None
         if asynchronous is None:
             self.asynchronous = trials.asynchronous
         else:
@@ -137,9 +153,10 @@ class FMinIter:
             if is_orbax_path(trials_save_file):
                 # direct FMinIter construction (no fmin() wrapper)
                 self._orbax_ckpt = TrialsCheckpointer(trials_save_file)
-        from .observability import PhaseTimings
+        from .observability import PhaseTimings, SpeculationStats
 
         self.timings = PhaseTimings()
+        self.speculation_stats = SpeculationStats()
 
         if self.asynchronous:
             if "FMinIter_Domain" not in trials.attachments:
@@ -182,6 +199,82 @@ class FMinIter:
                     break
         self.trials.refresh()
 
+    def _serial_evaluate_pipelined(self, engine, budget):
+        """serial_evaluate with suggest/evaluate overlap: the objective for
+        each NEW trial runs in a short-lived daemon worker thread while
+        this (main) thread speculatively launches the suggest program(s)
+        for the next trial(s) through ``engine`` (at most ``budget`` more
+        suggestions will ever be consumed this run, so speculation is
+        capped there too).  Doc mutations mirror serial_evaluate exactly;
+        on an objective exception the pending speculations are discarded
+        (their in-flight device work is abandoned) and the exception
+        propagates unless ``catch_eval_exceptions``.  The worker is a
+        daemon and the main thread's join is signal-interruptible, so
+        Ctrl-C still aborts fmin mid-objective just like the serial loop.
+        """
+        import threading
+
+        for trial in self.trials._dynamic_trials:
+            if trial["state"] != JOB_STATE_NEW:
+                continue
+            trial["state"] = JOB_STATE_RUNNING
+            now = coarse_utcnow()
+            trial["book_time"] = now
+            trial["refresh_time"] = now
+            spec = spec_from_misc(trial["misc"])
+            ctrl = Ctrl(self.trials, current_trial=trial)
+            box = {}
+
+            def _evaluate(spec=spec, ctrl=ctrl, box=box):
+                try:
+                    box["result"] = self.domain.evaluate(spec, ctrl)
+                except BaseException as e:
+                    box["error"] = e
+
+            worker = threading.Thread(
+                target=_evaluate, name="hyperopt-eval", daemon=True
+            )
+            worker.start()
+            try:
+                try:
+                    # overlap window: launch speculative suggests while
+                    # the objective runs; device compute proceeds in
+                    # background
+                    engine.speculate(limit=budget)
+                except Exception:
+                    # speculation is an optimization — a dispatch failure
+                    # (device error, bucket-growth compile OOM) must not
+                    # discard the objective's result or wedge the trial
+                    # in RUNNING; drop the speculations and run serially
+                    logger.exception(
+                        "speculative dispatch failed; continuing serially"
+                    )
+                    engine.discard()
+            finally:
+                # even a non-Exception failure must not abandon the
+                # trial mid-flight
+                worker.join()
+            if "error" in box:
+                e = box["error"]
+                if not isinstance(e, Exception):
+                    # BaseException (SystemExit, ...): serial_evaluate
+                    # would not catch it either — propagate unconditionally
+                    engine.discard()
+                    raise e
+                logger.error("job exception: %s", str(e))
+                trial["state"] = JOB_STATE_ERROR
+                trial["misc"]["error"] = (str(type(e)), str(e))
+                trial["refresh_time"] = coarse_utcnow()
+                if not self.catch_eval_exceptions:
+                    engine.discard()
+                    self.trials.refresh()
+                    raise e
+            else:
+                trial["state"] = JOB_STATE_DONE
+                trial["result"] = box["result"]
+                trial["refresh_time"] = coarse_utcnow()
+        self.trials.refresh()
+
     def block_until_done(self):
         already_printed = False
         if self.asynchronous:
@@ -217,6 +310,46 @@ class FMinIter:
             unfinished_states = [JOB_STATE_NEW, JOB_STATE_RUNNING]
             return self.trials.count_by_state_unsynced(unfinished_states)
 
+        # pipelined suggest engine (max_speculation > 0): overlap the
+        # device suggest program with objective evaluation.  k=0 keeps
+        # the original strictly-serial path below, bit-for-bit.  In the
+        # synchronous driver the engine only engages at queue length 1
+        # (the fmin default): a wider queue enqueues several ids through
+        # ONE algo call with ONE seed, which a 1-id speculation plus an
+        # (n-1)-id sync call would silently re-seed — batched enqueues
+        # keep the serial path instead.  The asynchronous plane has no
+        # serial trajectory to preserve and always gets the prefetch.
+        # Ctrl-receiving objectives (pass_expr_memo_ctrl) can mutate the
+        # trials store from the evaluation worker while this thread
+        # speculates against it — those keep the serial loop, where
+        # driver and objective never run concurrently.
+        engine = None
+        use_engine = (
+            self.max_speculation
+            and self.max_speculation > 0
+            and (self.asynchronous or self.max_queue_len == 1)
+            and not getattr(self.domain, "pass_expr_memo_ctrl", False)
+        )
+        if use_engine:
+            from .pipeline import SpeculativeSuggestEngine
+
+            if self._engine is None:
+                self._engine = SpeculativeSuggestEngine(
+                    algo,
+                    self.domain,
+                    trials,
+                    self.rstate,
+                    max_speculation=self.max_speculation,
+                    stats=self.speculation_stats,
+                )
+            engine = self._engine
+            if engine.policy == "strict":
+                # the engine never speculates for an algorithm without a
+                # declared policy (see hyperopt_tpu.pipeline) — skip the
+                # per-trial worker thread too and keep the serial loop,
+                # where main-thread-only objectives also keep working
+                engine = None
+
         stopped = False
         initial_n_done = get_n_done()
         progress_callback = (
@@ -224,7 +357,15 @@ class FMinIter:
             if self.show_progressbar
             else progress.no_progress_callback
         )
-        with progress_callback(initial=0, total=N) as progress_ctx:
+        with contextlib.ExitStack() as _stack:
+            if engine is not None:
+                # on every exit path, drop speculations that will never
+                # be consumed (normal completion leaves none thanks to
+                # the budget cap; early stops / exceptions may)
+                _stack.callback(engine.discard)
+            progress_ctx = _stack.enter_context(
+                progress_callback(initial=0, total=N)
+            )
             all_trials_complete = False
             best_loss = float("inf")
             n_displayed = 0
@@ -239,15 +380,21 @@ class FMinIter:
                     qlen < self.max_queue_len and n_queued < N and not self.is_cancelled
                 ):
                     n_to_enqueue = min(self.max_queue_len - qlen, N - n_queued)
-                    new_ids = trials.new_trial_ids(n_to_enqueue)
-                    self.trials.refresh()
-                    with self.timings.phase("suggest"):
-                        new_trials = algo(
-                            new_ids,
-                            self.domain,
-                            trials,
-                            self.rstate.integers(2 ** 31 - 1),
-                        )
+                    if engine is not None:
+                        # consumes a validated speculation when one is
+                        # pending (readback only), else computes in line
+                        with self.timings.phase("suggest"):
+                            new_trials, new_ids = engine.next_batch(n_to_enqueue)
+                    else:
+                        new_ids = trials.new_trial_ids(n_to_enqueue)
+                        self.trials.refresh()
+                        with self.timings.phase("suggest"):
+                            new_trials = algo(
+                                new_ids,
+                                self.domain,
+                                trials,
+                                self.rstate.integers(2 ** 31 - 1),
+                            )
                     if new_trials is None:
                         stopped = True
                         break
@@ -265,12 +412,34 @@ class FMinIter:
                     break
 
                 if self.asynchronous:
+                    if engine is not None:
+                        try:
+                            # prefetch the next suggestion(s) while the
+                            # backend's workers evaluate — the batched
+                            # plane rides the same speculation machinery
+                            # as the serial loop instead of a suggest
+                            # barrier
+                            engine.speculate(limit=N - n_queued)
+                        except Exception:
+                            # same contract as the sync plane: a failed
+                            # speculative dispatch degrades to the
+                            # serial protocol, it doesn't abort the run
+                            logger.exception(
+                                "speculative dispatch failed; continuing "
+                                "without prefetch"
+                            )
+                            engine.discard()
                     # wait for workers to fill in the trials
                     time.sleep(self.poll_interval_secs)
                 else:
                     # run the trials synchronously in this process
                     with self.timings.phase("evaluate"):
-                        self.serial_evaluate()
+                        if engine is not None:
+                            self._serial_evaluate_pipelined(
+                                engine, budget=N - n_queued
+                            )
+                        else:
+                            self.serial_evaluate()
 
                 self.trials.refresh()
                 if self.trials_save_file != "":
@@ -333,6 +502,8 @@ class FMinIter:
             self.trials.refresh()
             if self.verbose:
                 self.timings.log_summary(logging.DEBUG)
+                if engine is not None:
+                    self.speculation_stats.log_summary(logging.DEBUG)
             logger.debug("Queue empty, exiting run.")
 
     def exhaust(self):
@@ -361,12 +532,34 @@ def fmin(
     show_progressbar=True,
     early_stop_fn=None,
     trials_save_file="",
+    max_speculation=None,
 ):
     """Minimize ``fn`` over ``space`` — the reference's full signature.
 
     ``algo`` defaults to TPE.  ``rstate`` (a ``np.random.Generator``) makes
     the whole run deterministic, including the device-side jitted sampling
     (per-suggest seeds are drawn from it and turned into JAX PRNG keys).
+
+    ``max_speculation``: speculation depth ``k`` of the pipelined suggest
+    engine (:mod:`hyperopt_tpu.pipeline`) — while the objective for trial
+    *t* evaluates in a worker thread, the device suggest program for
+    trials *t+1…t+k* runs speculatively under the lands-above branch
+    prediction (the pending trial's known parameters join g(x); its
+    unknown loss only matters through γ-split membership), and is
+    re-issued against the completed history when the prediction fails.
+    ``0`` forces the strictly serial loop (suggest and evaluate times
+    add, trajectories bit-for-bit reproduce the pre-pipeline driver).
+    ``None`` (default) resolves to 1, or to ``HYPEROPT_MAX_SPECULATION``
+    when set.  Runs are deterministic under a fixed ``rstate`` for every
+    ``k``; at ``k=1`` with a deterministic objective the trajectory is
+    trial-for-trial IDENTICAL to the serial loop (consumed speculations
+    equal the post-completion serial suggestion exactly), while ``k>=2``
+    additionally misses not-yet-resolved intermediate suggestions —
+    bounded staleness TPE tolerates by design, traded for more overlap.
+    With ``k >= 1`` the objective runs in a short-lived worker thread
+    per trial; objectives that must run on the main thread (installing
+    signal handlers, ``signal.alarm`` timeouts, some GUI/event-loop
+    work) need ``max_speculation=0``.
     """
     if algo is None:
         from .algos import tpe
@@ -430,6 +623,7 @@ def fmin(
             early_stop_fn=early_stop_fn,
             trials_save_file=trials_save_file,
             points_to_evaluate=points_to_evaluate,
+            max_speculation=max_speculation,
         )
 
     if trials is None:
@@ -463,6 +657,7 @@ def fmin(
         early_stop_fn=early_stop_fn,
         trials_save_file=trials_save_file,
         orbax_ckpt=orbax_ckpt,
+        max_speculation=max_speculation,
     )
     rval.catch_eval_exceptions = catch_eval_exceptions
     try:

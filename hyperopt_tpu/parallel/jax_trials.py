@@ -73,11 +73,18 @@ class JaxTrials(Trials):
         mesh=None,
         exp_key=None,
         refresh=True,
+        max_speculation=None,
     ):
         """``timeout`` is the whole-run budget (SparkTrials semantics: it
         bounds ``fmin``, not a single trial); ``trial_timeout`` is the
         per-trial cancellation limit (timeout → ``JOB_STATE_CANCEL``).
-        They are independent knobs."""
+        They are independent knobs.
+
+        ``max_speculation``: staleness depth of the pipelined suggest
+        engine (see :func:`hyperopt_tpu.fmin.fmin`).  In this backend the
+        engine prefetches the next suggestion(s) while the dispatcher's
+        workers (or the device batch program) evaluate, replacing the
+        suggest barrier the enqueue/poll loop otherwise pays."""
         super().__init__(exp_key=exp_key, refresh=refresh)
         validate_timeout(timeout)
         validate_timeout(trial_timeout)
@@ -97,6 +104,7 @@ class JaxTrials(Trials):
         self.loss_threshold = loss_threshold
         self.device_fn = device_fn
         self.mesh = mesh
+        self.max_speculation = max_speculation
         self._fmin_state = None
 
     def fmin(
@@ -117,6 +125,7 @@ class JaxTrials(Trials):
         early_stop_fn=None,
         trials_save_file="",
         points_to_evaluate=None,
+        max_speculation=None,
     ):
         from ..fmin import fmin as _fmin
 
@@ -161,6 +170,11 @@ class JaxTrials(Trials):
                 early_stop_fn=early_stop_fn,
                 trials_save_file=trials_save_file,
                 points_to_evaluate=points_to_evaluate,
+                max_speculation=(
+                    max_speculation
+                    if max_speculation is not None
+                    else self.max_speculation
+                ),
             )
         finally:
             state.stop()

@@ -52,6 +52,7 @@ def main():
 
     from test_file_trials import test_fuzzed_filetrials_concurrency as t_queue
     from test_space_fuzz import (
+        PERM_RESAMPLE_SKIPS,
         test_compiled_matches_interpreted_on_random_space as t_sampler,
         test_fuzzed_space_fmin_end_to_end as t_fmin,
         test_fuzzed_space_mesh_device_tpe_agree as t_mesh,
@@ -69,15 +70,36 @@ def main():
         an fmin poll-loop deadlock) must surface as a recorded FAIL, not
         stall the campaign silently.  SIGALRM only interrupts the main
         thread at a bytecode boundary — enough for sleep/poll loops,
-        which is exactly the deadlock shape being guarded against."""
+        which is exactly the deadlock shape being guarded against.
+
+        The ``done`` flag closes the alarm's delivery race: the signal
+        can arrive BETWEEN ``fn(seed)`` returning and ``signal.alarm(0)``
+        disarming it, which would record a passing check as a deadlock
+        FAIL.  ``done`` is set immediately after ``fn`` returns and
+        ``on_alarm`` ignores a late signal when it is set (ADVICE r5).
+        The flag alone still leaves the one-bytecode window between
+        ``fn(seed)`` returning and the ``done = True`` store, so the
+        handler grants ONE tiny grace re-arm: if the store was next in
+        line it lands within the grace period and the second firing sees
+        it; a genuine deadlock just raises 50 ms later."""
+        done = False
+        grace_used = False
 
         def on_alarm(signum, frame):
+            nonlocal grace_used
+            if done:
+                return  # fn already returned; late delivery, not a hang
+            if not grace_used:
+                grace_used = True
+                signal.setitimer(signal.ITIMER_REAL, 0.05)
+                return
             raise TimeoutError(f"check exceeded {limit}s (deadlock?)")
 
         old = signal.signal(signal.SIGALRM, on_alarm)
         signal.alarm(limit)
         try:
             fn(seed)
+            done = True
         finally:
             signal.alarm(0)
             signal.signal(signal.SIGALRM, old)
@@ -102,9 +124,21 @@ def main():
                 f"{len(failures)} failures",
                 flush=True,
             )
+    # dropped coverage is part of the campaign record: every sampler
+    # check whose scale-agreement permutation was skipped (degenerate-std
+    # filter ate the resamples) would otherwise read as a full pass
+    if PERM_RESAMPLE_SKIPS:
+        print(
+            f"coverage: {len(PERM_RESAMPLE_SKIPS)} scale-agreement "
+            f"permutation check(s) SKIPPED (fewer than 100/300 resamples "
+            f"survived the degenerate-std filter): "
+            f"{PERM_RESAMPLE_SKIPS[:10]}",
+            flush=True,
+        )
     print(
         f"done: {N} seeds x {len(checks)} properties, "
-        f"{len(failures)} failures {failures[:10]}",
+        f"{len(failures)} failures {failures[:10]}, "
+        f"{len(PERM_RESAMPLE_SKIPS)} permutation-coverage skips",
         flush=True,
     )
     sys.exit(1 if failures else 0)

@@ -529,21 +529,39 @@ jobs.write(doc)
 # Randomized concurrency property (fuzz-campaign property 4)
 # ---------------------------------------------------------------------
 
-_chaos_counts = {}
-_chaos_counts_lock = threading.Lock()
+# Per-run evaluation-count registry, keyed by the run's queue dir: a
+# worker thread leaked from a previous wedged run (e.g. after the fuzz
+# campaign's watchdog fired mid-run) records into ITS OWN run's dict and
+# can no longer corrupt a later seed's exactly-once accounting the way a
+# single module-global dict could (ADVICE r5).
+_chaos_registry = {}
+_chaos_registry_lock = threading.Lock()
 
 
-def chaos_objective(cfg):
+class ChaosObjective:
     """Random-latency, randomly-failing objective that records how many
     times each sampled point was evaluated (uid = the x draw, unique per
-    trial with probability 1 under a continuous dist)."""
-    uid = round(float(cfg["x"]), 9)
-    with _chaos_counts_lock:
-        _chaos_counts[uid] = _chaos_counts.get(uid, 0) + 1
-    time.sleep(float(cfg["sleep_ms"]) / 1000.0)
-    if cfg["fail"]:
-        raise RuntimeError("chaos failure")
-    return (float(cfg["x"]) - 1.0) ** 2
+    trial with probability 1 under a continuous dist).  A picklable class
+    instance (FileTrials workers unpickle the domain) carrying its run
+    key; the counts dict itself stays process-local in the registry."""
+
+    def __init__(self, run_key):
+        self.run_key = run_key
+
+    @property
+    def counts(self):
+        with _chaos_registry_lock:
+            return _chaos_registry.setdefault(self.run_key, {})
+
+    def __call__(self, cfg):
+        uid = round(float(cfg["x"]), 9)
+        counts = self.counts
+        with _chaos_registry_lock:
+            counts[uid] = counts.get(uid, 0) + 1
+        time.sleep(float(cfg["sleep_ms"]) / 1000.0)
+        if cfg["fail"]:
+            raise RuntimeError("chaos failure")
+        return (float(cfg["x"]) - 1.0) ** 2
 
 
 @pytest.mark.parametrize("seed", range(2))
@@ -568,15 +586,14 @@ def test_fuzzed_filetrials_concurrency(seed):
         "fail": hp.pchoice("fail", [(1.0 - fail_p, 0), (fail_p, 1)]),
     }
 
-    with _chaos_counts_lock:
-        _chaos_counts.clear()
     with tempfile.TemporaryDirectory() as td:
         qdir = os.path.join(td, "q")
+        objective = ChaosObjective(qdir)  # qdir is unique per run
         trials = FileTrials(qdir)
         threads, stop = run_workers(qdir, n_workers=n_workers)
         try:
             fmin(
-                chaos_objective, space, algo=rand.suggest,
+                objective, space, algo=rand.suggest,
                 max_evals=n_trials, trials=trials,
                 catch_eval_exceptions=True,
                 rstate=np.random.default_rng(seed),
@@ -601,7 +618,8 @@ def test_fuzzed_filetrials_concurrency(seed):
             else:
                 assert "chaos failure" in d["misc"]["error"][1]
             assert d["owner"] is not None
-        with _chaos_counts_lock:
-            assert len(_chaos_counts) == n_trials
-            multi = {u: c for u, c in _chaos_counts.items() if c != 1}
+        with _chaos_registry_lock:
+            counts = dict(_chaos_registry.get(qdir, {}))
+        assert len(counts) == n_trials
+        multi = {u: c for u, c in counts.items() if c != 1}
         assert not multi, f"trials evaluated more than once: {multi}"

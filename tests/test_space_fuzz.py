@@ -19,6 +19,11 @@ import pytest
 from hyperopt_tpu import hp
 from hyperopt_tpu.vectorize import CompiledSpace
 
+# (seed, label, surviving_resamples) for every skipped scale-agreement
+# permutation check — read by scripts/fuzz_campaign.py to report dropped
+# coverage at the end of a campaign instead of letting it pass silently
+PERM_RESAMPLE_SKIPS = []
+
 N_COMPILED = 4000
 N_INTERP = 700
 
@@ -185,6 +190,21 @@ def test_compiled_matches_interpreted_on_random_space(seed):
                 # quantiles' own Monte-Carlo error at 300 resamples
                 assert lo_q - 0.15 <= obs <= hi_q + 0.15, (
                     lb, "perm", obs, lo_q, hi_q,
+                )
+            else:
+                # the degenerate-std filter ate the resamples and the
+                # scale-agreement check is being SKIPPED for this label —
+                # record the dropped coverage (counter + warning) so a
+                # campaign log shows it instead of silently passing
+                PERM_RESAMPLE_SKIPS.append((seed, lb, len(null)))
+                import warnings
+
+                warnings.warn(
+                    f"scale-agreement permutation check skipped for "
+                    f"{lb!r} (seed {seed}): only {len(null)}/300 "
+                    f"resamples survived the degenerate-std filter",
+                    RuntimeWarning,
+                    stacklevel=1,
                 )
             # The permutation null is blind to corruption present in
             # BOTH pooled halves, and the mean check's std-based scale
