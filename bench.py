@@ -426,10 +426,44 @@ def wallclock_section(argv):
     return bench_walltime.main(argv)
 
 
+def lint_section(argv):
+    """``python bench.py --lint [--quick]``: static-analysis smoke —
+    self-lint (race + static program passes) plus a short recompilation
+    audit of the fused TPE suggest program on CPU (100 trials, 40 with
+    ``--quick``; the full 200-trial tier runs via ``scripts/lint.py
+    --audit``).  Prints ONE JSON line like the other bench sections."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    n_audit = 40 if "--quick" in argv else 100
+    t0 = time.time()
+    from hyperopt_tpu.analysis import Severity, audit_tpe_run, lint_repo
+
+    diags = lint_repo(static_only=True)
+    aud = audit_tpe_run(n_trials=n_audit)
+    diags += aud.diagnostics()
+    out = {
+        "metric": "lint_smoke",
+        "value": len(diags),
+        "unit": "diagnostics",
+        "errors": sum(1 for d in diags if d.severity == Severity.ERROR),
+        "audit_trials": n_audit,
+        "audit_traces": aud.n_traces,
+        "audit_program_keys": aud.n_programs,
+        "audit_buckets": aud.bucket_summary(),
+        "elapsed_s": round(time.time() - t0, 2),
+    }
+    if diags:
+        out["rules"] = sorted({d.rule for d in diags})
+    print(json.dumps(out))
+    return 0
+
+
 def main():
     if "--wallclock" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--wallclock"]
         return wallclock_section(argv)
+    if "--lint" in sys.argv[1:]:
+        argv = [a for a in sys.argv[1:] if a != "--lint"]
+        return lint_section(argv)
     _ensure_live_backend()
     t_setup = time.time()
     import jax

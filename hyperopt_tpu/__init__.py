@@ -41,6 +41,7 @@ from .exceptions import (
     DuplicateLabel,
     InvalidLoss,
     InvalidResultStatus,
+    InvalidSpaceError,
     InvalidTrial,
 )
 from .fmin import (

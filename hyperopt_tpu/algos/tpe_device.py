@@ -793,6 +793,69 @@ def _index_family_suggest_core(
 
 _jit_cache = {}
 
+# Static-analyzer hooks (hyperopt_tpu.analysis.program_lint).  Both lists
+# are empty in production — the only overhead is a truthiness check.
+# ``_suggest_observers`` fire host-side once per dispatch with the raw
+# request list (the probe that lets the linter trace the live program to
+# a jaxpr).  ``_trace_observers`` fire at TRACE time inside the jitted
+# callable — each firing is one XLA retrace, the event the recompilation
+# auditor counts against its one-per-(trial-bucket, family) budget.
+_suggest_observers = []
+_trace_observers = []
+
+
+def _multi_sig(requests):
+    """Static jit-cache signature of one multi-family request set."""
+    return tuple(
+        (kind, tuple(sorted(st.items()))) for kind, _, st in requests
+    )
+
+
+def _build_multi_run(requests):
+    """The traced python callable for one fused multi-family suggest —
+    shared by the production jit path and the analyzer's jaxpr export so
+    the program the linter inspects IS the program production runs."""
+    import jax.numpy as jnp
+
+    sig = _multi_sig(requests)
+    cores = [
+        partial(
+            _family_suggest_core if kind == "cont"
+            else _index_family_suggest_core,
+            **st,
+        )
+        for kind, _, st in requests
+    ]
+
+    def run(args_list):
+        if _trace_observers:
+            shapes = tuple(
+                tuple(
+                    (tuple(a.shape), str(getattr(a, "dtype", "")))
+                    for a in args
+                )
+                for args in args_list
+            )
+            for obs in list(_trace_observers):
+                obs(sig, shapes)
+        outs = [core(*a) for core, a in zip(cores, args_list)]
+        return jnp.concatenate(
+            [o.astype(jnp.float32).reshape(-1) for o in outs]
+        )
+
+    return sig, run
+
+
+def multi_family_jaxpr(requests):
+    """ClosedJaxpr of the fused multi-family suggest program for
+    ``requests`` — tracing only, nothing executes on device.  Used by
+    :mod:`hyperopt_tpu.analysis.program_lint` to audit the exact
+    program production dispatches (host callbacks, dtype demotions)."""
+    import jax
+
+    _, run = _build_multi_run(requests)
+    return jax.make_jaxpr(run)([args for _, args, _ in requests])
+
 
 def multi_family_suggest_async(requests):
     """Launch ALL families of one suggest as ONE jitted device program,
@@ -809,29 +872,15 @@ def multi_family_suggest_async(requests):
     even though ``_apply_all_deltas`` donates them.
     """
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
-    sig = tuple(
-        (kind, tuple(sorted(st.items()))) for kind, _, st in requests
-    )
+    if _suggest_observers:
+        for obs in list(_suggest_observers):
+            obs(requests)
+    sig = _multi_sig(requests)
     fn = _jit_cache.get(("multi",) + sig)
     if fn is None:
-        cores = [
-            partial(
-                _family_suggest_core if kind == "cont"
-                else _index_family_suggest_core,
-                **st,
-            )
-            for kind, _, st in requests
-        ]
-
-        def run(args_list):
-            outs = [core(*a) for core, a in zip(cores, args_list)]
-            return jnp.concatenate(
-                [o.astype(jnp.float32).reshape(-1) for o in outs]
-            )
-
+        _, run = _build_multi_run(requests)
         fn = jax.jit(run)
         _jit_cache[("multi",) + sig] = fn
     flat_dev = fn([args for _, args, _ in requests])

@@ -1,0 +1,120 @@
+"""hyperopt_tpu.analysis — three-pass static analyzer.
+
+One structured-diagnostic model (rule id, severity, location, fix hint;
+:mod:`.diagnostics`) shared by three passes:
+
+- :func:`lint_space` (:mod:`.space_lint`) — walks the pyll graph of any
+  ``hp.*`` space: duplicate/shadowed labels, inverted bounds,
+  non-positive q/sigma, float32 overflow of log ranges, unreachable
+  choice branches, int-cast truncation.
+- :func:`lint_programs` (:mod:`.program_lint`) — traces the fused
+  suggest programs to jaxprs: host callbacks inside jit, silent
+  float64→float32 demotion, donation contract of the delta programs,
+  and a :class:`RecompilationAuditor` that bounds retraces to one per
+  (trial-count bucket, family).
+- :func:`lint_races` (:mod:`.race_lint`) — AST guarded-by checker over
+  the concurrent driver layers: fields annotated ``# guarded-by:
+  <lock>`` must be accessed under ``with self.<lock>:``, and lock
+  acquisition order is checked against a declared ``# lock-order:``.
+
+CLI: ``python -m hyperopt_tpu.analysis <target>`` (see ``--help``);
+CI entry point: ``scripts/lint.py``; pre-flight: ``fmin(...,
+validate_space=True)``.  Rule catalog: ``docs/static_analysis.md``.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .diagnostics import (
+    RULES,
+    Diagnostic,
+    Severity,
+    format_report,
+    has_errors,
+    sort_diagnostics,
+)
+from .program_lint import (
+    RecompilationAuditor,
+    audit_tpe_run,
+    lint_donation,
+    lint_programs,
+    lint_traced_program,
+)
+from .race_lint import lint_file, lint_source
+from .space_lint import lint_space
+
+__all__ = [
+    "RULES",
+    "Diagnostic",
+    "Severity",
+    "RecompilationAuditor",
+    "audit_tpe_run",
+    "format_report",
+    "has_errors",
+    "lint_donation",
+    "lint_file",
+    "lint_programs",
+    "lint_races",
+    "lint_repo",
+    "lint_source",
+    "lint_space",
+    "lint_traced_program",
+    "sort_diagnostics",
+]
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the concurrent driver layers whose guarded-by annotations the repo
+# self-lints (scripts/lint.py, tests/test_analysis.py)
+RACE_LINT_FILES = (
+    os.path.join(_PKG_ROOT, "pipeline.py"),
+    os.path.join(_PKG_ROOT, "parallel", "file_trials.py"),
+    os.path.join(_PKG_ROOT, "parallel", "jax_trials.py"),
+)
+
+
+def looks_like_space(obj) -> bool:
+    """Is ``obj`` a lintable search space?  (A pyll Apply, or a
+    non-empty dict whose values are all pyll Apply nodes.)  Single
+    definition shared by the CLI and scripts/lint.py so both always
+    agree on which module attributes get linted."""
+    from ..pyll.base import Apply
+
+    if isinstance(obj, Apply):
+        return True
+    return (
+        isinstance(obj, dict) and bool(obj)
+        and all(isinstance(v, Apply) for v in obj.values())
+    )
+
+
+def import_module_target(module: str):
+    """Import ``module`` — a dotted import path or a ``.py`` file."""
+    import importlib
+    import importlib.util
+
+    if module.endswith(".py") or os.path.sep in module:
+        name = os.path.splitext(os.path.basename(module))[0]
+        spec = importlib.util.spec_from_file_location(name, module)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    return importlib.import_module(module)
+
+
+def lint_races(paths=None, suppress=()):
+    """Race-lint ``paths`` (default: the repo's own concurrent layers)."""
+    out = []
+    for p in paths or RACE_LINT_FILES:
+        out.extend(lint_file(p, suppress=suppress))
+    return out
+
+
+def lint_repo(static_only: bool = True, suppress=()):
+    """Self-lint: race pass over the concurrent layers + program pass.
+    ``static_only=False`` additionally traces the live suggest program
+    (imports jax, runs a small CPU probe)."""
+    out = list(lint_races(suppress=suppress))
+    out.extend(lint_programs(static_only=static_only, suppress=suppress))
+    return out

@@ -1,0 +1,139 @@
+"""CLI: ``python -m hyperopt_tpu.analysis <target> ...``
+
+Targets:
+
+- ``space <module[:attr]>`` — space-lint a search space.  ``module`` is
+  a dotted import path or a ``.py`` file; ``attr`` names the space
+  object (default: every module-level attribute that looks like a
+  space: a dict of pyll nodes or a pyll Apply named ``space``/``SPACE``).
+- ``program [--audit [N]] [--static-only]`` — program-lint the fused
+  suggest programs; ``--audit`` additionally runs the N-trial (default
+  200) recompilation audit on CPU.
+- ``race <file.py> ...`` — guarded-by / lock-order check of source
+  files (default: the repo's own concurrent layers).
+- ``self`` — everything scripts/lint.py runs in CI: race pass over the
+  repo's pipeline/file_trials/jax_trials + static program audit.
+- a bare ``foo.py`` / ``pkg.module`` argument — inferred: ``.py`` file
+  → race pass; importable module → space pass.
+
+Exit code: number of ERROR-severity diagnostics (capped at 125), so
+``&&`` chains and CI steps can gate on it; ``--no-fail`` forces 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import (
+    format_report,
+    import_module_target,
+    lint_programs,
+    lint_races,
+    lint_space,
+    looks_like_space,
+    sort_diagnostics,
+)
+from .diagnostics import Severity
+from .program_lint import audit_tpe_run
+
+
+def _spaces_from(module_spec: str):
+    """[(name, space)] from ``module[:attr]``."""
+    if ":" in module_spec and not module_spec.endswith(".py"):
+        module, attr = module_spec.rsplit(":", 1)
+    else:
+        module, attr = module_spec, None
+    mod = import_module_target(module)
+    if attr is not None:
+        return [(f"{module}:{attr}", getattr(mod, attr))]
+    found = [
+        (f"{module}:{name}", obj)
+        for name, obj in sorted(vars(mod).items())
+        if not name.startswith("_") and looks_like_space(obj)
+    ]
+    if not found:
+        raise SystemExit(
+            f"no search-space objects found in {module!r}; name one "
+            f"explicitly: {module}:<attr>"
+        )
+    return found
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hyperopt_tpu.analysis",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("target", nargs="*", default=["self"])
+    ap.add_argument("--audit", nargs="?", const=200, type=int, default=None,
+                    metavar="N",
+                    help="run the N-trial recompilation audit (program "
+                         "pass; default N=200)")
+    ap.add_argument("--static-only", action="store_true",
+                    help="program pass: skip the live jaxpr trace")
+    ap.add_argument("--suppress", default="",
+                    help="comma-separated rule ids to suppress")
+    ap.add_argument("--no-fail", action="store_true",
+                    help="always exit 0 (report-only mode)")
+    args = ap.parse_args(argv)
+    suppress = tuple(x.strip() for x in args.suppress.split(",") if x.strip())
+
+    target = args.target or ["self"]
+    cmd, rest = target[0], target[1:]
+    diags = []
+    if cmd == "space":
+        if not rest:
+            ap.error("space: give a module[:attr] target")
+        for spec in rest:
+            for name, space in _spaces_from(spec):
+                ds = lint_space(space, suppress=suppress)
+                diags.extend(ds)
+                print(format_report(ds, header=f"== {name}"))
+        print(_summary(diags))
+    elif cmd == "program":
+        diags = lint_programs(static_only=args.static_only,
+                              suppress=suppress)
+        if args.audit is not None:
+            aud = audit_tpe_run(n_trials=args.audit)
+            diags.extend(aud.diagnostics(suppress=suppress))
+            print(
+                f"recompilation audit: {aud.n_traces} trace(s) across "
+                f"{aud.n_programs} program key(s); "
+                f"buckets={aud.bucket_summary()}"
+            )
+        print(format_report(diags, header="== program_lint"))
+    elif cmd == "race":
+        diags = lint_races(rest or None, suppress=suppress)
+        print(format_report(diags, header="== race_lint"))
+    elif cmd == "self":
+        diags = lint_races(suppress=suppress)
+        diags.extend(lint_programs(static_only=True, suppress=suppress))
+        print(format_report(diags, header="== self-lint (race + program)"))
+    else:
+        # inference: .py file -> race pass; importable module -> space
+        if cmd.endswith(".py") and os.path.exists(cmd):
+            diags = lint_races(target, suppress=suppress)
+            print(format_report(diags, header="== race_lint"))
+        else:
+            for spec in target:
+                for name, space in _spaces_from(spec):
+                    ds = lint_space(space, suppress=suppress)
+                    diags.extend(ds)
+                    print(format_report(ds, header=f"== {name}"))
+            print(_summary(diags))
+    if args.no_fail:
+        return 0
+    return min(sum(1 for d in diags if d.severity == Severity.ERROR), 125)
+
+
+def _summary(diags):
+    diags = sort_diagnostics(diags)
+    n_err = sum(1 for d in diags if d.severity == Severity.ERROR)
+    return f"total: {len(diags)} diagnostic(s), {n_err} error(s)"
+
+
+if __name__ == "__main__":
+    sys.exit(main())

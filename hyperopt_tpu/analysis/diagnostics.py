@@ -1,0 +1,257 @@
+"""Shared structured-diagnostic model for the three analysis passes.
+
+Every pass (:mod:`space_lint`, :mod:`program_lint`, :mod:`race_lint`)
+emits :class:`Diagnostic` records — rule id, severity, location (graph
+path for spaces, ``file:line`` for source), message, fix hint — so one
+reporter, one suppression mechanism, and one CI gate serve all three.
+
+Rule ids are namespaced by pass: ``SP1xx`` space rules, ``PL2xx``
+program rules, ``RL3xx`` race rules.  The catalog below is the single
+source of truth; ``docs/static_analysis.md`` renders it.
+
+Suppression:
+
+- API: every ``lint_*`` entry point accepts ``suppress=("SP105", ...)``.
+- Source comments (AST passes): ``# lint: disable=RL301`` on the
+  flagged line suppresses that rule there; ``# lint: disable`` with no
+  ids suppresses every rule on the line.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, List, Optional, Tuple
+
+
+class Severity:
+    """Ordered severity levels (compare with :func:`severity_rank`)."""
+
+    ERROR = "error"
+    WARNING = "warning"
+    INFO = "info"
+
+
+_SEVERITY_ORDER = {Severity.ERROR: 0, Severity.WARNING: 1, Severity.INFO: 2}
+
+
+def severity_rank(sev: str) -> int:
+    return _SEVERITY_ORDER.get(sev, 99)
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str
+    title: str
+    doc: str
+
+
+# ---------------------------------------------------------------------
+# Rule catalog (rendered in docs/static_analysis.md)
+# ---------------------------------------------------------------------
+
+RULES = {
+    r.id: r
+    for r in [
+        # -- space_lint ------------------------------------------------
+        Rule(
+            "SP101", Severity.ERROR, "duplicate-label",
+            "The same hyperparameter label names two distinct nodes "
+            "(e.g. re-declared in sibling hp.choice branches).  The "
+            "trials store keys observation history by label, so the two "
+            "parameters would silently share one history.",
+        ),
+        Rule(
+            "SP102", Severity.ERROR, "inverted-bounds",
+            "A bounded distribution has low >= high; sampling is "
+            "ill-defined and the device-side truncated-GMM draw "
+            "degenerates to NaN.",
+        ),
+        Rule(
+            "SP103", Severity.ERROR, "non-positive-q",
+            "A quantized distribution has q <= 0; the round(x/q)*q "
+            "lattice is undefined (division by zero on device).",
+        ),
+        Rule(
+            "SP104", Severity.ERROR, "non-positive-sigma",
+            "A normal-family distribution has sigma <= 0; the Parzen "
+            "fit and the sampler both divide by sigma.",
+        ),
+        Rule(
+            "SP105", Severity.ERROR, "f32-overflow-range",
+            "A log-scale range is wide enough that exp(high) overflows "
+            "float32 on device: observations and candidates become inf "
+            "and every EI score NaNs out, trials after the fit engages.",
+        ),
+        Rule(
+            "SP106", Severity.WARNING, "f32-underflow-range",
+            "A log-scale low is below log(float32 tiny) ≈ -87.3: "
+            "exp(low) underflows to 0 on device, and the fit-space "
+            "log transform clamps the dead region to a single point.",
+        ),
+        Rule(
+            "SP107", Severity.WARNING, "unreachable-branch",
+            "A choice branch can never be selected (hp.pchoice "
+            "probability 0, a single-option choice, or a contradictory "
+            "activation condition): its parameters receive no "
+            "observations and silently stay at the prior.",
+        ),
+        Rule(
+            "SP108", Severity.WARNING, "int-cast-truncation",
+            "An integer-valued distribution has parameters the final "
+            "int() cast will truncate asymmetrically: non-integer q on "
+            "uniformint/randint bounds, or a (high-low) span that is "
+            "not a multiple of q (the top lattice point is clipped).",
+        ),
+        # -- program_lint ----------------------------------------------
+        Rule(
+            "PL201", Severity.ERROR, "missing-donation",
+            "A device program on the history-append path does not "
+            "donate its state buffers: every append then copies the "
+            "whole history on device instead of updating in place.",
+        ),
+        Rule(
+            "PL202", Severity.ERROR, "forbidden-donation",
+            "A device program that must preserve its inputs (the "
+            "speculative hypothetical-append view reads a one-trial-"
+            "ahead copy while the live buffers stay current) donates "
+            "them: the next real sync would read freed buffers.",
+        ),
+        Rule(
+            "PL203", Severity.ERROR, "host-callback-in-jit",
+            "A fused suggest program contains a host callback "
+            "primitive (pure_callback / io_callback / debug.callback): "
+            "each invocation is a device->host round trip inside the "
+            "hot path, and non-blocking dispatch (the speculative "
+            "pipeline's overlap) stalls on it.",
+        ),
+        Rule(
+            "PL204", Severity.WARNING, "f64-weak-promotion",
+            "A float64 host array is fed to a jitted program with x64 "
+            "disabled: JAX silently demotes it to float32.  Pass "
+            "float32 explicitly so precision loss is a visible, "
+            "auditable choice.",
+        ),
+        Rule(
+            "PL205", Severity.ERROR, "excess-retrace",
+            "A fused device program re-traced for a (trial-count "
+            "bucket, family) it had already compiled: the jit cache "
+            "key leaks a per-call value, and every suggest pays a "
+            "recompile instead of O(log N) compiles per run.",
+        ),
+        # -- race_lint -------------------------------------------------
+        Rule(
+            "RL301", Severity.ERROR, "unguarded-access",
+            "A field annotated '# guarded-by: <lock>' is read or "
+            "written outside a 'with self.<lock>:' block (and outside "
+            "__init__): a concurrent mutator can interleave.",
+        ),
+        Rule(
+            "RL302", Severity.ERROR, "lock-order-inversion",
+            "Locks are acquired in an order that contradicts the "
+            "declared '# lock-order:' — two threads taking them in "
+            "opposite orders deadlock.",
+        ),
+        Rule(
+            "RL303", Severity.WARNING, "unknown-guard",
+            "A '# guarded-by:' annotation names a lock that is never "
+            "assigned in the class: the annotation is stale or "
+            "misspelled, so the discipline it declares is unchecked.",
+        ),
+    ]
+}
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One finding: rule id + severity + location + message + fix hint."""
+
+    rule: str
+    severity: str
+    location: str  # graph path ("choice['m'][1].x") or "file.py:123"
+    message: str
+    hint: str = ""
+
+    def format(self) -> str:
+        s = f"{self.location}: {self.severity}: {self.rule} " \
+            f"[{RULES[self.rule].title if self.rule in RULES else '?'}]: " \
+            f"{self.message}"
+        if self.hint:
+            s += f"\n    hint: {self.hint}"
+        return s
+
+
+def make(rule: str, location: str, message: str, hint: str = "",
+         severity: Optional[str] = None) -> Diagnostic:
+    """Build a Diagnostic with the catalog's default severity."""
+    if severity is None:
+        severity = RULES[rule].severity if rule in RULES else Severity.WARNING
+    return Diagnostic(rule=rule, severity=severity, location=location,
+                      message=message, hint=hint)
+
+
+# ---------------------------------------------------------------------
+# Suppression
+# ---------------------------------------------------------------------
+
+_DISABLE_RE = re.compile(r"#\s*lint:\s*disable(?:=([A-Z0-9, ]+))?")
+
+
+def line_suppressions(source_line: str) -> Optional[frozenset]:
+    """Rule ids disabled by a ``# lint: disable=...`` comment on the
+    line, ``frozenset()`` for a bare ``# lint: disable`` (all rules),
+    or None when the line has no suppression comment."""
+    m = _DISABLE_RE.search(source_line)
+    if m is None:
+        return None
+    if m.group(1) is None:
+        return frozenset()
+    return frozenset(x.strip() for x in m.group(1).split(",") if x.strip())
+
+
+def suppressed_by_comment(rule: str, source_line: str) -> bool:
+    sup = line_suppressions(source_line)
+    if sup is None:
+        return False
+    return len(sup) == 0 or rule in sup
+
+
+def apply_suppressions(
+    diags: Iterable[Diagnostic], suppress: Iterable[str] = ()
+) -> List[Diagnostic]:
+    sset = set(suppress or ())
+    return [d for d in diags if d.rule not in sset]
+
+
+# ---------------------------------------------------------------------
+# Reporting
+# ---------------------------------------------------------------------
+
+
+def sort_diagnostics(diags: Iterable[Diagnostic]) -> List[Diagnostic]:
+    return sorted(
+        diags, key=lambda d: (severity_rank(d.severity), d.rule, d.location)
+    )
+
+
+def format_report(diags: Iterable[Diagnostic], header: str = "") -> str:
+    diags = sort_diagnostics(diags)
+    lines = []
+    if header:
+        lines.append(header)
+    if not diags:
+        lines.append("no diagnostics")
+    else:
+        lines.extend(d.format() for d in diags)
+        n_err = sum(1 for d in diags if d.severity == Severity.ERROR)
+        n_warn = sum(1 for d in diags if d.severity == Severity.WARNING)
+        lines.append(
+            f"{len(diags)} diagnostic(s): {n_err} error(s), "
+            f"{n_warn} warning(s)"
+        )
+    return "\n".join(lines)
+
+
+def has_errors(diags: Iterable[Diagnostic]) -> bool:
+    return any(d.severity == Severity.ERROR for d in diags)

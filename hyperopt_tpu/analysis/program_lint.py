@@ -1,0 +1,431 @@
+"""Pass 2: lint of the fused device suggest programs.
+
+Three layers of checking over ``algos/tpe_device.py`` + ``ops/``:
+
+1. **Static donation audit** (no jax needed): the delta-apply program on
+   the history-append path must donate its state buffers
+   (``_apply_all_deltas``), and the speculative hypothetical-view
+   program must NOT (``_apply_all_deltas_preserve`` — the pipelined
+   engine reads a one-trial-ahead view while the live buffers stay
+   current for the next sync).  Checked by parsing the ``jax.jit`` /
+   ``partial(jax.jit, donate_argnums=...)`` wrappers in the source.
+
+2. **Jaxpr audit** (traces, never executes): a probe run captures the
+   live multi-family request set through
+   ``tpe_device._suggest_observers``, re-traces it with
+   :func:`tpe_device.multi_family_jaxpr`, and scans the jaxpr for host
+   callbacks inside jit (PL203) and float64 leakage (PL204) — plus a
+   host-side dtype check of the actual request arrays (the silent
+   f64→f32 weak-type demotion happens *before* tracing can see it).
+
+3. **Recompilation audit** (:class:`RecompilationAuditor`): registers
+   trace-time observers, runs a real CPU optimization, and reports any
+   device program traced more than once for the same (trial-count
+   bucket, family signature) — the symptom of a per-call value leaking
+   into the jit cache key (PL205).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+from .diagnostics import Diagnostic, apply_suppressions, make
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# program name -> must-donate-argnum-0?  The names are load-bearing:
+# tpe_device's sync path donates (old buffers are dead after an append),
+# the hypothetical path must not (pipeline.py's speculative view).
+_DONATION_EXPECTATIONS = {
+    os.path.join("algos", "tpe_device.py"): {
+        "_apply_all_deltas": True,
+        "_apply_all_deltas_preserve": False,
+    },
+}
+
+_CALLBACK_MARKERS = ("callback", "infeed", "outfeed", "debug_print")
+
+
+# ---------------------------------------------------------------------
+# 1. static donation audit
+# ---------------------------------------------------------------------
+
+
+def _jit_donate_argnums(node: ast.expr) -> Optional[Tuple[int, ...]]:
+    """Donated argnums of a jit-wrapper expression, () for an undonated
+    jit, None when the expression is not a jit wrapper at all.
+
+    Recognized forms::
+
+        jax.jit(f)                                   -> ()
+        jax.jit(f, donate_argnums=(0,))              -> (0,)
+        partial(jax.jit, donate_argnums=(0,))(f)     -> (0,)
+    """
+    if not isinstance(node, ast.Call):
+        return None
+
+    def is_jit(fn_node):
+        return (isinstance(fn_node, ast.Attribute) and fn_node.attr == "jit") \
+            or (isinstance(fn_node, ast.Name) and fn_node.id == "jit")
+
+    def donate_from(keywords):
+        for kw in keywords:
+            if kw.arg in ("donate_argnums", "donate_argnames"):
+                try:
+                    v = ast.literal_eval(kw.value)
+                except ValueError:
+                    return ("<dynamic>",)
+                if isinstance(v, int):
+                    return (v,)
+                return tuple(v)
+        return ()
+
+    if is_jit(node.func):
+        return donate_from(node.keywords)
+    if isinstance(node.func, ast.Call):
+        inner = node.func
+        inner_is_partial = (
+            (isinstance(inner.func, ast.Name) and inner.func.id == "partial")
+            or (isinstance(inner.func, ast.Attribute)
+                and inner.func.attr == "partial")
+        )
+        if inner_is_partial and any(is_jit(a) for a in inner.args):
+            return donate_from(inner.keywords)
+    return None
+
+
+def lint_donation(repo_root: str = _REPO_ROOT) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    for rel, expectations in _DONATION_EXPECTATIONS.items():
+        path = os.path.join(repo_root, rel)
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (OSError, SyntaxError) as e:
+            out.append(make("PL201", rel, f"cannot audit: {e}",
+                            severity="warning"))
+            continue
+        found: Dict[str, Tuple[int, Optional[Tuple]]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                if name in expectations:
+                    found[name] = (node.lineno, _jit_donate_argnums(node.value))
+        for name, must_donate in expectations.items():
+            if name not in found:
+                out.append(make(
+                    "PL201", rel,
+                    f"expected device program {name!r} not found; the "
+                    f"donation audit's expectation table is stale",
+                    severity="warning",
+                    hint="update _DONATION_EXPECTATIONS in "
+                         "analysis/program_lint.py",
+                ))
+                continue
+            lineno, donated = found[name]
+            loc = f"{rel}:{lineno}"
+            if donated is None:
+                out.append(make(
+                    "PL201", loc,
+                    f"{name} is no longer a recognizable jax.jit wrapper",
+                    severity="warning",
+                ))
+            elif must_donate and 0 not in donated:
+                out.append(make(
+                    "PL201", loc,
+                    f"{name} does not donate its state buffers "
+                    f"(donate_argnums={donated}): every history append "
+                    f"copies the whole on-device history",
+                    hint="wrap with partial(jax.jit, donate_argnums=(0,))",
+                ))
+            elif not must_donate and donated:
+                out.append(make(
+                    "PL202", loc,
+                    f"{name} donates {donated} but the speculative "
+                    f"hypothetical-append view must preserve the live "
+                    f"buffers for the next real sync",
+                    hint="use a plain jax.jit (no donate_argnums)",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------
+# 2. jaxpr audit
+# ---------------------------------------------------------------------
+
+
+def _iter_jaxprs(jaxpr):
+    """Yield ``jaxpr`` and every sub-jaxpr reachable through eqn params
+    (pjit bodies, scan/while bodies, cond branches)."""
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for v in eqn.params.values():
+            stack = [v]
+            while stack:
+                item = stack.pop()
+                if hasattr(item, "jaxpr") and hasattr(item, "consts"):
+                    yield from _iter_jaxprs(item.jaxpr)  # ClosedJaxpr
+                elif hasattr(item, "eqns"):
+                    yield from _iter_jaxprs(item)  # raw Jaxpr
+                elif isinstance(item, (tuple, list)):
+                    stack.extend(item)
+
+
+def scan_jaxpr(closed_jaxpr, location: str) -> List[Diagnostic]:
+    """PL203 (host callbacks) + PL204 (float64 leakage) over one traced
+    program, recursively through sub-jaxprs."""
+    out: List[Diagnostic] = []
+    for jx in _iter_jaxprs(closed_jaxpr.jaxpr):
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            if any(m in name for m in _CALLBACK_MARKERS):
+                out.append(make(
+                    "PL203", location,
+                    f"host callback primitive {name!r} inside the fused "
+                    f"suggest program",
+                    hint="move host work outside jit, or make it a "
+                         "device-side computation",
+                ))
+            if name == "convert_element_type":
+                src = getattr(eqn.invars[0], "aval", None)
+                dst = eqn.params.get("new_dtype")
+                if src is not None and str(getattr(src, "dtype", "")) == \
+                        "float64" and str(dst) == "float32":
+                    out.append(make(
+                        "PL204", location,
+                        "float64 value demoted to float32 inside the "
+                        "program",
+                    ))
+        for cv in getattr(jx, "constvars", ()):
+            if str(getattr(cv.aval, "dtype", "")) == "float64":
+                out.append(make(
+                    "PL204", location,
+                    "float64 constant captured by the traced program",
+                ))
+    return out
+
+
+def _request_dtype_diags(requests, location: str) -> List[Diagnostic]:
+    """Host-side check of the actual arrays fed to the program: with x64
+    disabled jit demotes float64 inputs to float32 silently, *before*
+    tracing — only the host can see it."""
+    import numpy as np
+
+    out: List[Diagnostic] = []
+    for fi, (kind, args, _st) in enumerate(requests):
+        for ai, a in enumerate(args):
+            dt = getattr(a, "dtype", None)
+            if dt is not None and str(dt) == "float64":
+                out.append(make(
+                    "PL204", f"{location} family#{fi} ({kind}) arg#{ai}",
+                    "float64 host array fed to the jitted suggest "
+                    "program; JAX will silently demote it to float32",
+                    hint="cast to np.float32 at the call site so the "
+                         "precision loss is explicit",
+                ))
+    return out
+
+
+def _probe_space():
+    """A representative space exercising every device family kind:
+    plain/log/quantized continuous, normal, index (choice + randint)."""
+    from .. import hp
+
+    return {
+        "u": hp.uniform("u", -2.0, 2.0),
+        "lu": hp.loguniform("lu", -4.0, 2.0),
+        "qu": hp.quniform("qu", 0.0, 10.0, 2.0),
+        "n": hp.normal("n", 0.0, 1.0),
+        "c": hp.choice("c", [0, 1, 2]),
+        "ri": hp.randint("ri", 4),
+    }
+
+
+def capture_requests(n_trials: int = 26, seed: int = 0):
+    """Run a small CPU optimization over the probe space and capture the
+    LAST multi-family request set the production suggest dispatched."""
+    import numpy as np
+
+    from .. import Trials, fmin
+    from ..algos import tpe, tpe_device
+
+    captured: List = []
+    tpe_device._suggest_observers.append(captured.append)
+    try:
+        fmin(
+            lambda c: float(c["u"] ** 2 + c["n"] ** 2 + 0.1 * c["c"]),
+            _probe_space(),
+            algo=partial(tpe.suggest, n_EI_candidates=8),
+            max_evals=n_trials,
+            trials=Trials(),
+            rstate=np.random.default_rng(seed),
+            show_progressbar=False,
+            verbose=False,
+            max_speculation=0,
+        )
+    finally:
+        tpe_device._suggest_observers.remove(captured.append)
+    if not captured:
+        raise RuntimeError(
+            f"probe run of {n_trials} trials dispatched no device suggest "
+            f"(n_startup_jobs not exceeded?)"
+        )
+    return captured[-1]
+
+
+def lint_traced_program(requests=None) -> List[Diagnostic]:
+    """Trace the live fused suggest program and scan its jaxpr."""
+    from ..algos import tpe_device
+
+    if requests is None:
+        requests = capture_requests()
+    loc = "tpe_device.multi_family_suggest"
+    out = _request_dtype_diags(requests, loc)
+    closed = tpe_device.multi_family_jaxpr(requests)
+    out.extend(scan_jaxpr(closed, loc))
+    return out
+
+
+# ---------------------------------------------------------------------
+# 3. recompilation auditor
+# ---------------------------------------------------------------------
+
+
+class RecompilationAuditor:
+    """Counts XLA retraces of the fused suggest program per (static
+    signature, concrete shape set) while active.
+
+    The steady-state contract (tpe_device module docstring): buffers
+    grow in power-of-two buckets, so over an N-trial run each fused
+    program compiles O(log N) times — exactly once per (trial-count
+    bucket, family signature).  A second trace of the SAME key means a
+    per-call value leaked into the cache key (dtype/weak-type flapping,
+    a non-hashable static regressed to per-call identity, cache
+    eviction) and every suggest is paying a recompile.
+
+    Use as a context manager around any optimization run::
+
+        with RecompilationAuditor() as aud:
+            fmin(...)
+        assert not aud.diagnostics()
+    """
+
+    def __init__(self):
+        self.trace_counts: Dict[Tuple, int] = {}
+        self._keys_in_order: List[Tuple] = []
+
+    # -- observer wiring ----------------------------------------------
+    def _observe(self, sig, shapes):
+        key = (sig, shapes)
+        n = self.trace_counts.get(key, 0)
+        if n == 0:
+            self._keys_in_order.append(key)
+        self.trace_counts[key] = n + 1
+
+    def __enter__(self):
+        from ..algos import tpe_device
+
+        tpe_device._trace_observers.append(self._observe)
+        return self
+
+    def __exit__(self, *exc):
+        from ..algos import tpe_device
+
+        try:
+            tpe_device._trace_observers.remove(self._observe)
+        except ValueError:
+            pass
+        return False
+
+    # -- reporting ----------------------------------------------------
+    @property
+    def n_traces(self) -> int:
+        return sum(self.trace_counts.values())
+
+    @property
+    def n_programs(self) -> int:
+        return len(self.trace_counts)
+
+    def bucket_summary(self) -> List[Tuple[int, int]]:
+        """[(history_capacity_bucket, n_traces)] — the losses buffer is
+        the [CAPT] argument shared by every family, so its length is the
+        trial-count bucket of the trace."""
+        buckets: Dict[int, int] = {}
+        for (sig, shapes), n in self.trace_counts.items():
+            # family arg layout (tpe_device._family_suggest_core): the
+            # losses buffer [CAPT] is positional arg 4 of every family
+            capt = 0
+            if shapes and len(shapes[0]) > 4 and len(shapes[0][4][0]) == 1:
+                capt = shapes[0][4][0][0]
+            buckets[capt] = buckets.get(capt, 0) + n
+        return sorted(buckets.items())
+
+    def diagnostics(self, suppress=()) -> List[Diagnostic]:
+        out = []
+        for key in self._keys_in_order:
+            n = self.trace_counts[key]
+            if n <= 1:
+                continue
+            sig, shapes = key
+            fams = ", ".join(kind for kind, _ in sig)
+            out.append(make(
+                "PL205",
+                f"tpe_device.multi_family_suggest[{fams}]",
+                f"program re-traced {n}x for one (trial-count bucket, "
+                f"family) key; shapes={shapes}",
+                hint="a per-call value is leaking into the jit cache "
+                     "key — check statics for unhashable or per-call "
+                     "objects and arguments for dtype/weak-type "
+                     "instability",
+            ))
+        return apply_suppressions(out, suppress)
+
+
+def audit_tpe_run(n_trials: int = 200, seed: int = 0, space=None,
+                  objective=None, n_EI_candidates: int = 8):
+    """Run an ``n_trials`` CPU optimization under the auditor and return
+    it.  Clears the device-program cache first so the audit observes the
+    full compile schedule from a cold start."""
+    import numpy as np
+
+    from .. import Trials, fmin
+    from ..algos import tpe, tpe_device
+
+    if space is None:
+        space = _probe_space()
+    if objective is None:
+        def objective(c):
+            return float(c["u"] ** 2 + c["n"] ** 2 + 0.1 * c["c"])
+    tpe_device._jit_cache.clear()
+    aud = RecompilationAuditor()
+    with aud:
+        fmin(
+            objective,
+            space,
+            algo=partial(tpe.suggest, n_EI_candidates=n_EI_candidates),
+            max_evals=n_trials,
+            trials=Trials(),
+            rstate=np.random.default_rng(seed),
+            show_progressbar=False,
+            verbose=False,
+            max_speculation=0,
+        )
+    return aud
+
+
+# ---------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------
+
+
+def lint_programs(static_only: bool = False, suppress=()) -> List[Diagnostic]:
+    """All program checks.  ``static_only`` skips the jaxpr trace (no
+    jax import, sub-second — the CI fast path)."""
+    out = lint_donation()
+    if not static_only:
+        out.extend(lint_traced_program())
+    return apply_suppressions(out, suppress)

@@ -1,0 +1,260 @@
+"""Pass 3: AST-based guarded-by / lock-order checker.
+
+The concurrent layers (``pipeline.py``, ``parallel/jax_trials.py``,
+``parallel/file_trials.py``) declare their lock discipline in comments;
+this pass statically enforces it:
+
+- ``self.foo = ...  # guarded-by: _lock`` — field ``foo`` of the
+  enclosing class may only be read or written inside a
+  ``with self._lock:`` block (``__init__`` is exempt: the object is not
+  yet shared during construction).
+- ``# guarded-by: trials._dynamic_trials: _mutate_lock`` — a standalone
+  comment anywhere in a class body guards a *dotted* attribute path
+  reached through ``self`` (here ``self.trials._dynamic_trials``).
+- ``# lock-order: _a < _b`` (module or class level) — declares that
+  ``_a`` must be acquired before ``_b``; a ``with self._b:`` containing
+  a ``with self._a:`` is an inversion (RL302).
+- ``# lint: disable=RL301`` on an access line suppresses the finding
+  there.
+
+Lexical semantics, deliberately conservative: a closure defined inside a
+``with`` block does NOT inherit the held-locks set (it may run later on
+another thread), and helper methods called under a lock are not credited
+— annotate the access site or restructure so the access is lexically
+under the ``with``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .diagnostics import (
+    Diagnostic,
+    apply_suppressions,
+    make,
+    suppressed_by_comment,
+)
+
+_GUARD_RE = re.compile(r"#\s*guarded-by:\s*([\w.]+)(?:\s*:\s*(\w+))?")
+_ORDER_RE = re.compile(r"#\s*lock-order:\s*([\w<> .]+)")
+_SELF_ASSIGN_RE = re.compile(r"self\.(\w+)\s*[:=]")
+
+
+def _attr_chain(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """('trials', '_dynamic_trials') for ``self.trials._dynamic_trials``;
+    None when the chain does not root at ``self``."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name) and node.id == "self":
+        return tuple(reversed(parts))
+    return None
+
+
+class _ClassSpec:
+    def __init__(self, name):
+        self.name = name
+        self.guards: Dict[Tuple[str, ...], str] = {}  # attr path -> lock
+        self.guard_lines: Dict[Tuple[str, ...], int] = {}
+        self.lock_order: List[str] = []
+        self.assigned_attrs: set = set()
+
+
+def _parse_annotations(tree: ast.Module, lines: List[str], path: str):
+    """Class specs (+ module-level lock order) from comments + AST."""
+    module_order: List[str] = []
+    classes: List[Tuple[ast.ClassDef, _ClassSpec]] = []
+
+    class_ranges = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            spec = _ClassSpec(node.name)
+            classes.append((node, spec))
+            end = max(
+                (n.end_lineno or n.lineno for n in ast.walk(node)
+                 if hasattr(n, "lineno")),
+                default=node.lineno,
+            )
+            class_ranges.append((node.lineno, end, spec))
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    targets = (
+                        sub.targets if isinstance(sub, ast.Assign)
+                        else [sub.target]
+                    )
+                    for t in targets:
+                        chain = _attr_chain(t)
+                        if chain and len(chain) == 1:
+                            spec.assigned_attrs.add(chain[0])
+
+    def owner(lineno) -> Optional[_ClassSpec]:
+        best = None
+        for lo, hi, spec in class_ranges:
+            if lo <= lineno <= hi:
+                # innermost (latest-starting) enclosing class wins
+                if best is None or lo > best[0]:
+                    best = (lo, spec)
+        return best[1] if best else None
+
+    for i, line in enumerate(lines, start=1):
+        m = _GUARD_RE.search(line)
+        if m:
+            target, lock = m.group(1), m.group(2)
+            spec = owner(i)
+            if lock is None:
+                # inline form: `self.X = ...  # guarded-by: _lock`
+                lock = target
+                am = _SELF_ASSIGN_RE.search(line.split("#", 1)[0])
+                if am is None or spec is None:
+                    continue  # prose mention, not an annotation site
+                attr_path: Tuple[str, ...] = (am.group(1),)
+            else:
+                if spec is None:
+                    continue
+                attr_path = tuple(target.split("."))
+            spec.guards[attr_path] = lock
+            spec.guard_lines[attr_path] = i
+        m = _ORDER_RE.search(line)
+        if m and "<" in m.group(1):
+            order = [x.strip() for x in m.group(1).split("<")]
+            spec = owner(i)
+            if spec is not None:
+                spec.lock_order = order
+            else:
+                module_order[:] = order
+
+    for _, spec in classes:
+        if not spec.lock_order:
+            spec.lock_order = module_order
+    return classes
+
+
+class _MethodChecker(ast.NodeVisitor):
+    def __init__(self, spec: _ClassSpec, lines, path, diags):
+        self.spec = spec
+        self.lines = lines
+        self.path = path
+        self.diags = diags
+        self.held: List[str] = []
+
+    # -- lock tracking -------------------------------------------------
+    def visit_With(self, node: ast.With):
+        # items acquire left-to-right: each lock joins the held set
+        # BEFORE the next item's order check, so a single multi-item
+        # statement (`with self._b, self._a:`) is checked exactly like
+        # the nested form
+        acquired = []
+        for item in node.items:
+            self.visit(item.context_expr)
+            chain = _attr_chain(item.context_expr)
+            if chain and len(chain) == 1:
+                lock = chain[0]
+                self._check_order(lock, node.lineno)
+                self.held.append(lock)
+                acquired.append(lock)
+        for stmt in node.body:
+            self.visit(stmt)
+        if acquired:
+            del self.held[-len(acquired):]
+
+    def _check_order(self, lock: str, lineno: int):
+        order = self.spec.lock_order
+        if lock not in order:
+            return
+        for h in self.held:
+            if h in order and order.index(lock) < order.index(h):
+                if suppressed_by_comment("RL302", self.lines[lineno - 1]):
+                    continue
+                self.diags.append(make(
+                    "RL302", f"{self.path}:{lineno}",
+                    f"acquires {lock!r} while holding {h!r}, but the "
+                    f"declared lock-order is "
+                    f"{' < '.join(order)}",
+                    hint="release the inner lock first, or fix the "
+                         "declared order if it is wrong",
+                ))
+
+    # -- closures do not inherit held locks -----------------------------
+    def _visit_scoped(self, node):
+        saved, self.held = self.held, []
+        self.generic_visit(node)
+        self.held = saved
+
+    def visit_FunctionDef(self, node):
+        self._visit_scoped(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._visit_scoped(node)
+
+    def visit_Lambda(self, node):
+        self._visit_scoped(node)
+
+    # -- guarded accesses ----------------------------------------------
+    def visit_Attribute(self, node: ast.Attribute):
+        chain = _attr_chain(node)
+        if chain is not None:
+            # exact match only: a longer chain (self._pending.append)
+            # contains the exact node (self._pending) as a sub-expression,
+            # so prefix matching would double-report
+            for attr_path, lock in self.spec.guards.items():
+                if chain == attr_path and lock not in self.held:
+                    line = self.lines[node.lineno - 1]
+                    if not suppressed_by_comment("RL301", line):
+                        self.diags.append(make(
+                            "RL301", f"{self.path}:{node.lineno}",
+                            f"{self.spec.name}: access to "
+                            f"'self.{'.'.join(attr_path)}' (guarded by "
+                            f"'{lock}', declared at line "
+                            f"{self.spec.guard_lines.get(attr_path, '?')}) "
+                            f"outside 'with self.{lock}:'",
+                            hint=f"wrap the access in 'with self.{lock}:' "
+                                 f"or add '# lint: disable=RL301' with a "
+                                 f"justification",
+                        ))
+                    break
+        self.generic_visit(node)
+
+
+def lint_source(source: str, path: str = "<string>",
+                suppress=()) -> List[Diagnostic]:
+    """Race-lint one Python source string."""
+    lines = source.splitlines()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [make("RL301", f"{path}:{e.lineno}",
+                     f"cannot parse: {e.msg}", severity="error")]
+    diags: List[Diagnostic] = []
+    for cls_node, spec in _parse_annotations(tree, lines, path):
+        if not spec.guards:
+            continue
+        # RL303: stale/misspelled guard annotations
+        for attr_path, lock in spec.guards.items():
+            if lock not in spec.assigned_attrs:
+                diags.append(make(
+                    "RL303",
+                    f"{path}:{spec.guard_lines.get(attr_path, cls_node.lineno)}",
+                    f"{spec.name}: guard lock 'self.{lock}' for "
+                    f"'self.{'.'.join(attr_path)}' is never assigned in "
+                    f"the class",
+                    hint="fix the lock name in the annotation, or create "
+                         "the lock in __init__",
+                ))
+        for item in cls_node.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name == "__init__":
+                continue
+            checker = _MethodChecker(spec, lines, path, diags)
+            for stmt in item.body:
+                checker.visit(stmt)
+    return apply_suppressions(diags, suppress)
+
+
+def lint_file(path: str, suppress=()) -> List[Diagnostic]:
+    """Race-lint one Python file."""
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path, suppress=suppress)

@@ -10,6 +10,22 @@ class BadSearchSpace(Exception):
     """The search space is malformed."""
 
 
+class InvalidSpaceError(BadSearchSpace):
+    """A space parameter is statically invalid (inverted bounds,
+    non-positive q/sigma, ...), caught at ``hp.*`` construction time or
+    by the ``fmin(..., validate_space=True)`` pre-flight — instead of a
+    device-side NaN many trials later.
+
+    ``label`` is the offending hyperparameter's label (None when the
+    failure is not tied to one label); ``diagnostics`` carries the
+    structured findings when raised by the pre-flight."""
+
+    def __init__(self, msg, label=None, diagnostics=()):
+        super().__init__(msg)
+        self.label = label
+        self.diagnostics = tuple(diagnostics)
+
+
 class DuplicateLabel(BadSearchSpace):
     """The same hyperparameter label is used by two distinct nodes."""
 

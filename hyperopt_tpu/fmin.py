@@ -533,6 +533,7 @@ def fmin(
     early_stop_fn=None,
     trials_save_file="",
     max_speculation=None,
+    validate_space=False,
 ):
     """Minimize ``fn`` over ``space`` — the reference's full signature.
 
@@ -560,7 +561,32 @@ def fmin(
     per trial; objectives that must run on the main thread (installing
     signal handlers, ``signal.alarm`` timeouts, some GUI/event-loop
     work) need ``max_speculation=0``.
+
+    ``validate_space=True`` runs the static space linter
+    (:func:`hyperopt_tpu.analysis.lint_space`) before the first trial:
+    error-severity findings (duplicate labels, inverted bounds,
+    float32-overflowing log ranges, ...) raise
+    :class:`~hyperopt_tpu.exceptions.InvalidSpaceError` immediately —
+    instead of a device-side NaN many trials in — and warnings are
+    logged.  Off by default: the lint walks the whole space graph,
+    which is wasted work for the common already-validated space.
     """
+    if validate_space:
+        from .analysis import Severity, lint_space
+        from .exceptions import InvalidSpaceError
+
+        diags = lint_space(space)
+        errors = [d for d in diags if d.severity == Severity.ERROR]
+        for d in diags:
+            if d.severity != Severity.ERROR:
+                logger.warning("space lint: %s", d.format())
+        if errors:
+            raise InvalidSpaceError(
+                "search space failed validation:\n"
+                + "\n".join(d.format() for d in errors),
+                diagnostics=diags,
+            )
+
     if algo is None:
         from .algos import tpe
 

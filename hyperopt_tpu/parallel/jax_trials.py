@@ -212,12 +212,17 @@ class _JaxFMinState:
         self._stop = threading.Event()
         self._thread = None
         self._pool = None
-        # Guards every multi-field trial-doc mutation from worker threads.
-        # Invariant the driver's refresh() relies on: a trial whose state
-        # reads DONE always already has its result written — so result is
-        # assigned before state inside the locked region, and the driver
-        # (reading under the GIL) can never observe DONE-without-result.
+        # Guards every multi-field trial-doc mutation from worker threads
+        # AND the dispatcher's scan of the shared trial-doc list (the
+        # guarded-by declaration below is enforced statically by
+        # hyperopt_tpu.analysis.race_lint).  Invariant the driver's
+        # refresh() relies on: a trial whose state reads DONE always
+        # already has its result written — so result is assigned before
+        # state inside the locked region, and the driver (reading under
+        # the GIL) can never observe DONE-without-result.
         self._mutate_lock = threading.Lock()
+
+    # guarded-by: trials._dynamic_trials: _mutate_lock
 
     # -- lifecycle -----------------------------------------------------
     def start(self):

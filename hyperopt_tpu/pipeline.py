@@ -62,6 +62,7 @@ a fixed ``rstate`` fixes the whole trajectory for any ``k``.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 import weakref
 from collections import deque
@@ -178,7 +179,26 @@ class SpeculativeSuggestEngine:
         self.stats = stats if stats is not None else SpeculationStats()
         self.policy, self.policy_params = _policy_for(algo)
         self._algo_async = _async_variant(algo)
-        self._pending = deque()
+        # The serial driver calls the engine from one thread, but the
+        # async plane interleaves speculate() (main loop) with backend
+        # dispatcher threads and future backends may prefetch from
+        # worker callbacks — so the engine carries an explicit
+        # two-level lock discipline, enforced statically by
+        # hyperopt_tpu.analysis.race_lint (see docs/static_analysis.md):
+        #
+        # - ``_dispatch_lock`` (reentrant, coarse) serializes the
+        #   compound schedule operations — speculate's check+draw+
+        #   launch+append, next_batch's validate+pop+resolve, discard —
+        #   so concurrent callers cannot overshoot max_speculation or
+        #   interleave rstate draws (which would break the k=1
+        #   bit-for-bit serial-trajectory guarantee).
+        # - ``_pending_lock`` (fine) guards the queue state itself, so
+        #   cheap inspections never wait behind a blocking readback.
+        #
+        # lock-order: _dispatch_lock < _pending_lock
+        self._dispatch_lock = threading.RLock()
+        self._pending_lock = threading.Lock()
+        self._pending = deque()  # guarded-by: _pending_lock
 
     # -- snapshot / validation ----------------------------------------
     def _snapshot(self):
@@ -292,20 +312,25 @@ class SpeculativeSuggestEngine:
         invalidated (same ids, same seed, fresh history).  ``exposed``:
         the caller is on the driver's critical path (consume time), so
         re-issue launch cost must not be booked as hidden time."""
-        if not self._pending:
-            return
-        if all(self._still_valid(sp.snap) for sp in self._pending):
-            return
-        # the speculations were issued against successive rstate draws in
-        # trial order; one stale γ-split invalidates them all (each later
-        # speculation was fit on the same stale history)
-        stale = list(self._pending)
-        self._pending.clear()
+        with self._pending_lock:
+            if not self._pending:
+                return
+            if all(self._still_valid(sp.snap) for sp in self._pending):
+                return
+            # the speculations were issued against successive rstate
+            # draws in trial order; one stale γ-split invalidates them
+            # all (each later speculation was fit on the same stale
+            # history)
+            stale = list(self._pending)
+            self._pending.clear()
         self.stats.record_invalidation(len(stale))
         for sp in stale:
             t0 = time.perf_counter()
             resolve, snap = self._launch_spec(sp.ids, sp.seed)
-            self._pending.append(_Speculation(sp.ids, sp.seed, resolve, snap))
+            with self._pending_lock:
+                self._pending.append(
+                    _Speculation(sp.ids, sp.seed, resolve, snap)
+                )
             self.stats.record_dispatch(
                 time.perf_counter() - t0, hypothesis=snap[0] == "hyp",
                 exposed=exposed,
@@ -371,24 +396,31 @@ class SpeculativeSuggestEngine:
             # every completed trial would invalidate a strict speculation
             # (see module docstring): don't burn the work, stay serial
             return
-        # the driver may have completed trials since the last refresh
-        # (several NEW trials evaluated back-to-back, e.g.
-        # points_to_evaluate warm starts): validation and the pending
-        # scan below must see those losses, or a completed-but-unsynced
-        # trial is neither in the history nor hypothesized and a
-        # re-issued speculation silently loses its observation
-        self.trials.refresh()
-        self._validate()
-        while len(self._pending) < cap:
-            t0 = time.perf_counter()
-            ids = self.trials.new_trial_ids(batch_size)
+        with self._dispatch_lock:
+            # the driver may have completed trials since the last refresh
+            # (several NEW trials evaluated back-to-back, e.g.
+            # points_to_evaluate warm starts): validation and the pending
+            # scan below must see those losses, or a completed-but-
+            # unsynced trial is neither in the history nor hypothesized
+            # and a re-issued speculation silently loses its observation
             self.trials.refresh()
-            seed = int(self.rstate.integers(2 ** 31 - 1))
-            resolve, snap = self._launch_spec(ids, seed)
-            self._pending.append(_Speculation(ids, seed, resolve, snap))
-            self.stats.record_dispatch(
-                time.perf_counter() - t0, hypothesis=snap[0] == "hyp"
-            )
+            self._validate()
+            while True:
+                with self._pending_lock:
+                    if len(self._pending) >= cap:
+                        break
+                t0 = time.perf_counter()
+                ids = self.trials.new_trial_ids(batch_size)
+                self.trials.refresh()
+                seed = int(self.rstate.integers(2 ** 31 - 1))
+                resolve, snap = self._launch_spec(ids, seed)
+                with self._pending_lock:
+                    self._pending.append(
+                        _Speculation(ids, seed, resolve, snap)
+                    )
+                self.stats.record_dispatch(
+                    time.perf_counter() - t0, hypothesis=snap[0] == "hyp"
+                )
 
     # -- consumption ---------------------------------------------------
     def next_batch(self, n):
@@ -399,51 +431,62 @@ class SpeculativeSuggestEngine:
         draw per suggest call either way.  Returns ``(new_trials,
         new_ids)``; ``new_trials`` is None when the algorithm signalled a
         stop and nothing was produced."""
-        self._validate(exposed=True)
-        docs, ids = [], []
-        while self._pending and len(ids) + len(self._pending[0].ids) <= n:
-            sp = self._pending.popleft()
-            t0 = time.perf_counter()
-            try:
-                out = sp.resolve()
-                self.stats.record_resolve(time.perf_counter() - t0)
-            except Exception:
-                # JAX defers device-side execution errors to the
-                # readback; a speculation-only failure must not abort a
-                # run that would have completed serially — drop every
-                # in-flight speculation and recompute this one
-                # synchronously with ITS ids and seed (the serial
-                # protocol's exact call)
-                logger.exception(
-                    "speculative readback failed; recomputing synchronously"
-                )
-                self.discard()
-                t1 = time.perf_counter()
-                out = self.algo(sp.ids, self.domain, self.trials, sp.seed)
-                self.stats.record_sync(time.perf_counter() - t1)
-            if out is None:
-                return (docs if docs else None), ids
-            docs.extend(out)
-            ids.extend(sp.ids)
-        rem = n - len(ids)
-        if rem > 0:
-            fresh = self.trials.new_trial_ids(rem)
-            self.trials.refresh()
-            seed = int(self.rstate.integers(2 ** 31 - 1))
-            t0 = time.perf_counter()
-            out = self.algo(fresh, self.domain, self.trials, seed)
-            self.stats.record_sync(time.perf_counter() - t0)
-            if out is None:
-                return (docs if docs else None), ids + fresh
-            docs.extend(out)
-            ids.extend(fresh)
-        return docs, ids
+        with self._dispatch_lock:
+            self._validate(exposed=True)
+            docs, ids = [], []
+            while True:
+                with self._pending_lock:
+                    if not self._pending or (
+                        len(ids) + len(self._pending[0].ids) > n
+                    ):
+                        break
+                    sp = self._pending.popleft()
+                t0 = time.perf_counter()
+                try:
+                    out = sp.resolve()
+                    self.stats.record_resolve(time.perf_counter() - t0)
+                except Exception:
+                    # JAX defers device-side execution errors to the
+                    # readback; a speculation-only failure must not abort
+                    # a run that would have completed serially — drop
+                    # every in-flight speculation and recompute this one
+                    # synchronously with ITS ids and seed (the serial
+                    # protocol's exact call)
+                    logger.exception(
+                        "speculative readback failed; recomputing "
+                        "synchronously"
+                    )
+                    self.discard()
+                    t1 = time.perf_counter()
+                    out = self.algo(
+                        sp.ids, self.domain, self.trials, sp.seed
+                    )
+                    self.stats.record_sync(time.perf_counter() - t1)
+                if out is None:
+                    return (docs if docs else None), ids
+                docs.extend(out)
+                ids.extend(sp.ids)
+            rem = n - len(ids)
+            if rem > 0:
+                fresh = self.trials.new_trial_ids(rem)
+                self.trials.refresh()
+                seed = int(self.rstate.integers(2 ** 31 - 1))
+                t0 = time.perf_counter()
+                out = self.algo(fresh, self.domain, self.trials, seed)
+                self.stats.record_sync(time.perf_counter() - t0)
+                if out is None:
+                    return (docs if docs else None), ids + fresh
+                docs.extend(out)
+                ids.extend(fresh)
+            return docs, ids
 
     def discard(self):
         """Drop every pending speculation (in-flight device work is
         abandoned, never read).  Used when the run stops or an objective
         exception propagates mid-speculation."""
-        n = len(self._pending)
+        with self._dispatch_lock:
+            with self._pending_lock:
+                n = len(self._pending)
+                self._pending.clear()
         if n:
-            self._pending.clear()
             self.stats.record_discard(n)

@@ -1,0 +1,121 @@
+"""CI lint entry point: self-lint the repo with hyperopt_tpu.analysis.
+
+Runs, in order of cost:
+
+1. **race pass** over the concurrent driver layers (``pipeline.py``,
+   ``parallel/file_trials.py``, ``parallel/jax_trials.py``) — enforces
+   their own ``# guarded-by`` / ``# lock-order`` annotations;
+2. **program pass, static** — the jax.jit donation contract of the
+   device delta programs (no jax import);
+3. **space pass** over every ``examples/`` space and the QUALITY.md
+   benchmark domains (imports jax transitively via hyperopt_tpu);
+4. with ``--trace``: the live jaxpr audit of the fused suggest program
+   (host callbacks, f64 demotion — runs a small CPU probe);
+5. with ``--audit [N]``: the N-trial (default 200) recompilation audit.
+
+Exit code 0 even when diagnostics are found (the tier-1 flow runs this
+as a NON-blocking step; the hard gate is tests/test_analysis.py, which
+asserts zero diagnostics on the same targets).  ``--strict`` exits with
+the error count instead.  Run: ``python scripts/lint.py [--fast]``.
+"""
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _example_spaces():
+    """[(name, space)] from every examples/*.py module-level space."""
+    from hyperopt_tpu.analysis import import_module_target, looks_like_space
+
+    out = []
+    ex_dir = os.path.join(_REPO, "examples")
+    for fname in sorted(os.listdir(ex_dir)):
+        if not fname.endswith(".py"):
+            continue
+        mod = import_module_target(os.path.join(ex_dir, fname))
+        for name, obj in vars(mod).items():
+            if not name.startswith("_") and looks_like_space(obj):
+                out.append((f"examples/{fname}:{name}", obj))
+    return out
+
+
+def _quality_domains():
+    from hyperopt_tpu.models import domains
+
+    return [
+        (f"QUALITY.md:{n}", domains.get(n).space)
+        for n in ("quadratic1", "branin", "gauss_wave2", "hartmann6")
+    ]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--fast", action="store_true",
+                    help="race + static program passes only (no jax)")
+    ap.add_argument("--trace", action="store_true",
+                    help="also trace the live suggest program to a jaxpr")
+    ap.add_argument("--audit", nargs="?", const=200, type=int, default=None,
+                    metavar="N", help="also run the N-trial recompilation "
+                                      "audit (default N=200)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero on error diagnostics (default: "
+                         "report-only — CI runs this non-blocking)")
+    args = ap.parse_args(argv)
+
+    from hyperopt_tpu.analysis import (
+        Severity,
+        format_report,
+        lint_programs,
+        lint_races,
+        lint_space,
+    )
+
+    diags = list(lint_races())
+    print(format_report(diags, header="== race pass (guarded-by/lock-order)"))
+
+    prog = lint_programs(static_only=True)
+    print(format_report(prog, header="== program pass (donation, static)"))
+    diags += prog
+
+    if not args.fast:
+        spaces = _example_spaces() + _quality_domains()
+        for name, space in spaces:
+            ds = lint_space(space)
+            if ds:
+                print(format_report(ds, header=f"== space pass: {name}"))
+            diags += ds
+        print(f"== space pass: {len(spaces)} spaces checked")
+
+        if args.trace or args.audit is not None:
+            from hyperopt_tpu.analysis import lint_traced_program
+
+            tr = lint_traced_program()
+            print(format_report(tr, header="== program pass (jaxpr trace)"))
+            diags += tr
+        if args.audit is not None:
+            from hyperopt_tpu.analysis import audit_tpe_run
+
+            aud = audit_tpe_run(n_trials=args.audit)
+            ds = aud.diagnostics()
+            print(
+                f"== recompilation audit: {aud.n_traces} trace(s) / "
+                f"{aud.n_programs} program key(s) over {args.audit} "
+                f"trials; buckets={aud.bucket_summary()}"
+            )
+            print(format_report(ds))
+            diags += ds
+
+    n_err = sum(1 for d in diags if d.severity == Severity.ERROR)
+    print(f"\nlint: {len(diags)} diagnostic(s), {n_err} error(s)")
+    if args.strict and n_err:
+        return min(n_err, 125)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

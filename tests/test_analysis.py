@@ -1,0 +1,565 @@
+"""Tests for hyperopt_tpu.analysis — the three-pass static analyzer.
+
+Structure mirrors the acceptance contract:
+
+- a fixture corpus of deliberately broken spaces/programs/sources with
+  GOLDEN diagnostics (every seeded violation must be caught, by rule id);
+- zero-false-positive runs over every ``examples/`` space, the four
+  QUALITY.md benchmark domains, and the repo's own concurrent layers;
+- the recompilation auditor asserting the fused TPE suggest program
+  retraces at most once per trial-count bucket over a 200-trial CPU run;
+- the construction-time validation satellites (InvalidSpaceError,
+  path-qualified DuplicateLabel, fmin validate_space pre-flight).
+"""
+
+import importlib.util
+import os
+import subprocess
+import sys
+import textwrap
+from functools import partial
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu.analysis import (
+    RULES,
+    Severity,
+    lint_donation,
+    lint_races,
+    lint_source,
+    lint_space,
+)
+from hyperopt_tpu.analysis.diagnostics import (
+    format_report,
+    has_errors,
+    line_suppressions,
+)
+from hyperopt_tpu.analysis.program_lint import (
+    RecompilationAuditor,
+    _request_dtype_diags,
+    audit_tpe_run,
+    scan_jaxpr,
+)
+from hyperopt_tpu.exceptions import DuplicateLabel, InvalidSpaceError
+from hyperopt_tpu.pyll.base import scope
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _raw(label, dist, *args):
+    """A hyperparameter node built through raw scope composition —
+    bypasses the hp.* construction-time checks, exactly how a malformed
+    space arrives from deserialization or third-party graph builders."""
+    wrap = scope.int if dist in ("uniformint",) else scope.float
+    return wrap(scope.hyperopt_param(label, getattr(scope, dist)(*args)))
+
+
+def _rules(diags):
+    return sorted(d.rule for d in diags)
+
+
+# ---------------------------------------------------------------------
+# fixture corpus: broken spaces -> golden rule ids
+# ---------------------------------------------------------------------
+
+SPACE_CORPUS = [
+    # (name, space builder, expected rule ids (sorted))
+    ("inverted_uniform",
+     lambda: {"x": _raw("x", "uniform", 5.0, 1.0)}, ["SP102"]),
+    ("inverted_loguniform",
+     lambda: {"x": _raw("x", "loguniform", 2.0, -2.0)}, ["SP102"]),
+    ("negative_q",
+     lambda: {"x": _raw("x", "quniform", 0.0, 10.0, -1.0)}, ["SP103"]),
+    ("zero_sigma_normal",
+     lambda: {"x": _raw("x", "normal", 0.0, 0.0)}, ["SP104"]),
+    ("negative_sigma_lognormal",
+     lambda: {"x": _raw("x", "lognormal", 0.0, -2.0)}, ["SP104"]),
+    ("loguniform_overflow",
+     lambda: {"x": _raw("x", "loguniform", 0.0, 100.0)}, ["SP105"]),
+    ("loguniform_underflow",
+     lambda: {"x": _raw("x", "loguniform", -120.0, 1.0)}, ["SP106"]),
+    ("duplicate_across_dict",
+     lambda: {"a": hp.uniform("x", 0, 1), "b": hp.uniform("x", 0, 1)},
+     ["SP101"]),
+    ("duplicate_across_branches_raw",
+     # raw switch graph (hp.choice now rejects this at construction)
+     lambda: scope.switch(
+         scope.hyperopt_param("m", scope.randint(2)),
+         {"lr": _raw("lr", "uniform", 0.0, 1.0)},
+         {"lr": _raw("lr", "uniform", 5.0, 9.0)},
+     ),
+     ["SP101"]),
+    ("pchoice_dead_branch",
+     lambda: hp.pchoice("f", [(0.0, "off"), (1.0, "on")]), ["SP107"]),
+    ("single_option_choice",
+     lambda: {"c": hp.choice("c", ["only"])}, ["SP107"]),
+    ("uniformint_fractional_q",
+     # span 9 is a multiple of q=1.5, so exactly the fractional-q
+     # truncation hazard fires
+     lambda: {"x": _raw("x", "uniformint", 0.0, 9.0, 1.5)}, ["SP108"]),
+    ("quniform_span_not_multiple",
+     lambda: {"x": hp.quniform("x", 0.0, 10.0, 3.0)}, ["SP108"]),
+    ("randint_empty_range",
+     lambda: {"x": scope.hyperopt_param("x", scope.randint(7, 3))},
+     ["SP102"]),
+    ("randint_fractional_bounds",
+     lambda: {"x": scope.hyperopt_param("x", scope.randint(1.5, 7.0))},
+     ["SP108"]),
+    ("inverted_and_overflow_combo",
+     lambda: {
+         "a": _raw("a", "uniform", 3.0, 3.0),
+         "b": _raw("b", "loguniform", -1.0, 200.0),
+     },
+     ["SP102", "SP105"]),
+]
+
+
+@pytest.mark.parametrize(
+    "name,build,expected", SPACE_CORPUS, ids=[c[0] for c in SPACE_CORPUS]
+)
+def test_space_corpus_golden(name, build, expected):
+    diags = lint_space(build())
+    assert _rules(diags) == expected, format_report(diags, header=name)
+    for d in diags:
+        assert d.rule in RULES
+        assert d.severity == RULES[d.rule].severity
+        assert d.location  # every finding is located
+        assert d.message
+
+
+def test_space_lint_never_raises_on_garbage():
+    class Weird:
+        pass
+
+    # literals mixed into a space are fine; non-graph inputs degrade to
+    # an empty (or diagnostic-only) report, never an exception
+    assert lint_space({"x": hp.uniform("x", 0, 1), "y": 3, "z": "s"}) == []
+    for garbage in (Weird(), None, [1, "a", None]):
+        assert isinstance(lint_space(garbage), list)
+
+
+def test_space_lint_suppression():
+    space = {"x": _raw("x", "uniform", 5.0, 1.0)}
+    assert _rules(lint_space(space)) == ["SP102"]
+    assert lint_space(space, suppress=("SP102",)) == []
+
+
+def test_shared_node_across_branches_is_not_duplicate():
+    shared = hp.uniform("lr", 0, 1)
+    space = hp.choice("m", [{"lr": shared}, {"lr": shared, "e": hp.uniform("e", 0, 1)}])
+    assert lint_space(space) == []
+
+
+def test_nested_choice_paths_in_duplicate_message():
+    space = scope.switch(
+        scope.hyperopt_param("outer", scope.randint(2)),
+        {"lr": _raw("lr", "uniform", 0.0, 1.0)},
+        scope.switch(
+            scope.hyperopt_param("inner", scope.randint(2)),
+            {"lr": _raw("lr", "uniform", 5.0, 9.0)},
+            0,
+        ),
+    )
+    diags = [d for d in lint_space(space) if d.rule == "SP101"]
+    assert len(diags) == 1
+    # the location names both branch paths
+    assert "choice['outer'][0]" in diags[0].location
+    assert "choice['inner'][0]" in diags[0].location
+
+
+# ---------------------------------------------------------------------
+# zero false positives: examples/ + QUALITY.md domains
+# ---------------------------------------------------------------------
+
+
+def _load_lint_script():
+    spec = importlib.util.spec_from_file_location(
+        "_lint_script", os.path.join(_REPO, "scripts", "lint.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_examples_and_quality_domains_zero_diagnostics():
+    lint_script = _load_lint_script()
+    spaces = lint_script._example_spaces() + lint_script._quality_domains()
+    assert len(spaces) >= 8  # every example + the 4 QUALITY.md domains
+    for name, space in spaces:
+        diags = lint_space(space)
+        assert diags == [], format_report(diags, header=name)
+
+
+# ---------------------------------------------------------------------
+# program_lint
+# ---------------------------------------------------------------------
+
+
+def test_donation_contract_clean_on_repo():
+    assert lint_donation() == []
+
+
+def test_donation_contract_catches_seeded_violations(tmp_path):
+    bad = textwrap.dedent(
+        """
+        import jax
+        from functools import partial
+
+        def _deltas_body(state, idx):
+            return state
+
+        _apply_all_deltas = jax.jit(_deltas_body)  # lost its donation
+        _apply_all_deltas_preserve = partial(
+            jax.jit, donate_argnums=(0,)
+        )(_deltas_body)  # donates what it must preserve
+        """
+    )
+    (tmp_path / "algos").mkdir()
+    (tmp_path / "algos" / "tpe_device.py").write_text(bad)
+    diags = lint_donation(repo_root=str(tmp_path))
+    assert _rules(diags) == ["PL201", "PL202"]
+    assert all(d.severity == Severity.ERROR for d in diags)
+
+
+def test_host_callback_detected_in_jaxpr():
+    import jax
+    import jax.numpy as jnp
+
+    def bad(x):
+        jax.debug.callback(lambda v: None, x)
+        return x * 2
+
+    diags = scan_jaxpr(jax.make_jaxpr(bad)(jnp.ones(3)), "fixture")
+    assert "PL203" in _rules(diags)
+
+    def good(x):
+        return x * 2
+
+    assert scan_jaxpr(jax.make_jaxpr(good)(jnp.ones(3)), "fixture") == []
+
+
+def test_f64_request_arg_detected():
+    reqs = [("cont", (np.zeros((2, 8), np.float32),
+                      np.zeros((2, 8), np.float64)), {})]
+    diags = _request_dtype_diags(reqs, "fixture")
+    assert _rules(diags) == ["PL204"]
+    reqs_ok = [("cont", (np.zeros((2, 8), np.float32),), {})]
+    assert _request_dtype_diags(reqs_ok, "fixture") == []
+
+
+def test_traced_live_program_clean():
+    from hyperopt_tpu.analysis import lint_traced_program
+
+    assert lint_traced_program() == []
+
+
+def test_recompilation_auditor_flags_synthetic_retrace():
+    aud = RecompilationAuditor()
+    sig = (("cont", (("k", 1),)),)
+    shapes = (((("s"), "f32"),),)
+    aud._observe(sig, shapes)
+    assert aud.diagnostics() == []
+    aud._observe(sig, shapes)
+    diags = aud.diagnostics()
+    assert _rules(diags) == ["PL205"]
+    assert diags[0].severity == Severity.ERROR
+
+
+def test_recompilation_audit_200_trials_cpu():
+    """Acceptance criterion: the fused TPE suggest program retraces at
+    most once per (trial-count bucket, family) across a 200-trial run."""
+    aud = audit_tpe_run(n_trials=200, seed=0)
+    assert aud.diagnostics() == [], format_report(aud.diagnostics())
+    # the audit actually observed the compile schedule (cold cache) and
+    # it is the documented O(log N) one: every program key traced once,
+    # history buckets strictly growing powers of two
+    assert aud.n_traces >= 3
+    assert all(n == 1 for n in aud.trace_counts.values())
+    buckets = [b for b, _ in aud.bucket_summary()]
+    assert buckets == sorted(set(buckets))
+    for b in buckets:
+        assert b & (b - 1) == 0, f"non-power-of-two bucket {b}"
+
+
+# ---------------------------------------------------------------------
+# race_lint: fixture corpus + repo self-lint
+# ---------------------------------------------------------------------
+
+RACE_FIXTURE = textwrap.dedent(
+    """
+    import threading
+
+    class Engine:
+        # lock-order: _a < _b
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self._pending = []  # guarded-by: _a
+            self.trials = None
+        # guarded-by: trials._dynamic_trials: _b
+
+        def good(self):
+            with self._a:
+                self._pending.append(1)
+            with self._a:
+                with self._b:
+                    return list(self.trials._dynamic_trials)
+
+        def bad_unguarded_read(self):
+            return len(self._pending)
+
+        def bad_unguarded_write(self):
+            self._pending = []
+
+        def bad_dotted(self):
+            return list(self.trials._dynamic_trials)
+
+        def bad_inversion(self):
+            with self._b:
+                with self._a:
+                    self._pending.clear()
+
+        def bad_closure_leak(self):
+            with self._a:
+                def cb():
+                    self._pending.pop()
+                return cb
+
+        def suppressed(self):
+            return self._pending[:]  # lint: disable=RL301
+
+    class Stale:
+        def __init__(self):
+            self.x = 1  # guarded-by: _missing_lock
+    """
+)
+
+
+def test_race_corpus_golden():
+    diags = lint_source(RACE_FIXTURE, "fixture.py")
+    assert _rules(diags) == [
+        "RL301",  # bad_unguarded_read
+        "RL301",  # bad_unguarded_write
+        "RL301",  # bad_dotted
+        "RL301",  # bad_closure_leak
+        "RL302",  # bad_inversion
+        "RL303",  # Stale._missing_lock
+    ]
+    by_rule = {}
+    for d in diags:
+        by_rule.setdefault(d.rule, []).append(d)
+    # the closure finding is the one inside cb(): held locks do not
+    # leak into closures that may run on another thread
+    assert any("_pending" in d.message for d in by_rule["RL301"])
+    assert "lock-order is _a < _b" in by_rule["RL302"][0].message
+
+
+def test_race_lint_multi_item_with_inversion():
+    """`with self._b, self._a:` is the same inversion as the nested
+    form and must be flagged identically."""
+    src = textwrap.dedent(
+        """
+        import threading
+        class C:
+            # lock-order: _a < _b
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._q = []  # guarded-by: _a
+            def inverted(self):
+                with self._b, self._a:
+                    self._q.clear()
+            def ordered(self):
+                with self._a, self._b:
+                    self._q.clear()
+        """
+    )
+    diags = lint_source(src, "f.py")
+    assert _rules(diags) == ["RL302"]
+    assert diags[0].location.endswith(":10")  # the `with self._b, self._a:`
+
+
+def test_race_lint_init_is_exempt():
+    src = textwrap.dedent(
+        """
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []  # guarded-by: _lock
+                self._q.append(0)  # construction: not yet shared
+        """
+    )
+    assert lint_source(src, "f.py") == []
+
+
+def test_race_lint_suppression_comment():
+    assert line_suppressions("x = 1  # lint: disable=RL301") == {"RL301"}
+    assert line_suppressions("x = 1  # lint: disable") == frozenset()
+    assert line_suppressions("x = 1") is None
+
+
+def test_repo_concurrent_layers_self_lint_clean():
+    """The satellite gate: pipeline.py / file_trials.py / jax_trials.py
+    carry real guarded-by annotations and comply with them."""
+    diags = lint_races()
+    assert diags == [], format_report(diags)
+    # non-vacuous: the annotations exist and are parsed
+    import ast
+
+    from hyperopt_tpu.analysis import RACE_LINT_FILES
+    from hyperopt_tpu.analysis.race_lint import _parse_annotations
+
+    n_guards = 0
+    for path in RACE_LINT_FILES:
+        with open(path) as f:
+            src = f.read()
+        for _cls, spec in _parse_annotations(
+            ast.parse(src), src.splitlines(), path
+        ):
+            n_guards += len(spec.guards)
+    assert n_guards >= 3
+
+
+def test_race_lint_catches_seeded_repo_violation():
+    """Mutating pipeline.py to drop a with-block MUST produce RL301 —
+    guards that the self-lint green is not vacuous."""
+    path = os.path.join(_REPO, "hyperopt_tpu", "pipeline.py")
+    with open(path) as f:
+        src = f.read()
+    mutated = src.replace(
+        "        with self._dispatch_lock:\n"
+        "            with self._pending_lock:\n"
+        "                n = len(self._pending)\n"
+        "                self._pending.clear()\n",
+        "        n = len(self._pending)\n"
+        "        self._pending.clear()\n",
+    )
+    assert mutated != src, "discard() lock block not found; update test"
+    diags = lint_source(mutated, "pipeline.py")
+    assert "RL301" in _rules(diags)
+
+
+# ---------------------------------------------------------------------
+# construction-time validation satellites
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "build",
+    [
+        lambda: hp.uniform("x", 5, 1),
+        lambda: hp.quniform("x", 0, 10, 0),
+        lambda: hp.uniformint("x", 3, 3),
+        lambda: hp.loguniform("x", 1.0, 1.0),
+        lambda: hp.qloguniform("x", 0, 1, -2),
+        lambda: hp.normal("x", 0, 0),
+        lambda: hp.qnormal("x", 0, -1, 1),
+        lambda: hp.lognormal("x", 0, 0),
+        lambda: hp.qlognormal("x", 0, 1, 0),
+        lambda: hp.randint("x", 0),
+        lambda: hp.randint("x", 8, 3),
+    ],
+)
+def test_constructors_raise_invalid_space(build):
+    with pytest.raises(InvalidSpaceError) as ei:
+        build()
+    assert ei.value.label == "x"
+    assert "'x'" in str(ei.value)
+
+
+def test_constructors_accept_expression_params():
+    # non-literal parameters cannot be validated statically and must
+    # still construct (the reference allows pyll expressions as bounds)
+    width = scope.uniform(0.5, 1.5)
+    hp.normal("x", 0, width)  # no raise
+
+
+def test_choice_duplicate_label_path_qualified():
+    with pytest.raises(DuplicateLabel) as ei:
+        hp.choice(
+            "m",
+            [{"lr": hp.uniform("lr", 0, 1)}, {"lr": hp.uniform("lr", 5, 9)}],
+        )
+    msg = str(ei.value)
+    assert "'lr'" in msg and "'m'" in msg
+    assert "branch 0 vs branch 1" in msg
+
+
+def test_pchoice_duplicate_label_raises():
+    with pytest.raises(DuplicateLabel):
+        hp.pchoice(
+            "m",
+            [(0.5, {"a": hp.uniform("z", 0, 1)}),
+             (0.5, {"a": hp.uniform("z", 2, 3)})],
+        )
+
+
+def test_choice_shared_node_still_legal():
+    shared = hp.uniform("lr", 0, 1)
+    hp.choice("m", [{"lr": shared}, {"lr": shared}])  # no raise
+
+
+def test_fmin_validate_space_preflight():
+    bad = {"x": _raw("x", "uniform", 5.0, 1.0)}
+    with pytest.raises(InvalidSpaceError) as ei:
+        fmin(
+            lambda c: c["x"], bad, max_evals=3, trials=Trials(),
+            rstate=np.random.default_rng(0), show_progressbar=False,
+            verbose=False, validate_space=True,
+        )
+    assert ei.value.diagnostics  # structured findings ride the exception
+    assert any(d.rule == "SP102" for d in ei.value.diagnostics)
+
+
+def test_fmin_validate_space_passes_good_space():
+    from hyperopt_tpu.algos import rand
+
+    best = fmin(
+        lambda c: c["x"] ** 2, {"x": hp.uniform("x", -1, 1)},
+        algo=rand.suggest, max_evals=3, trials=Trials(),
+        rstate=np.random.default_rng(0), show_progressbar=False,
+        verbose=False, validate_space=True,
+    )
+    assert "x" in best
+
+
+# ---------------------------------------------------------------------
+# tooling: CLI + scripts/lint.py wired into the tier-1 flow
+# ---------------------------------------------------------------------
+
+
+def test_cli_race_pass_exit_code(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(RACE_FIXTURE)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "hyperopt_tpu.analysis", "race", str(bad)],
+        capture_output=True, text=True, cwd=_REPO, env=env, timeout=300,
+    )
+    # exit code = error count (5 errors in the fixture)
+    assert proc.returncode == 5, proc.stdout + proc.stderr
+    assert "RL301" in proc.stdout and "RL302" in proc.stdout
+
+
+def test_scripts_lint_nonblocking_self_lint():
+    """scripts/lint.py --fast self-lints the repo's own guarded-by
+    annotations + donation contracts and exits 0 (non-blocking step)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join("scripts", "lint.py"), "--fast"],
+        capture_output=True, text=True, cwd=_REPO, env=env, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "race pass" in proc.stdout
+    assert "0 error(s)" in proc.stdout
+
+
+def test_diagnostic_model_report_shape():
+    diags = lint_space({"x": _raw("x", "loguniform", 0.0, 100.0)})
+    assert has_errors(diags)
+    rep = format_report(diags, header="hdr")
+    assert rep.startswith("hdr")
+    assert "SP105" in rep and "hint:" in rep
