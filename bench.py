@@ -457,6 +457,52 @@ def lint_section(argv):
     return 0
 
 
+def chaos_section(argv):
+    """``python bench.py --chaos [--quick]``: fault-tolerance smoke — a
+    short seeded chaos campaign on CPU (scripts/chaos_campaign.py):
+    worker kills, torn locks, delayed/duplicated results, objective
+    errors/hangs, and synthetic device errors injected against a
+    FileTrials run and a serial TPE run; asserts zero stranded
+    reservations, reconciled fault accounting, and best-trial equality
+    with the fault-free twin.  Prints ONE JSON line like the other
+    bench sections."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    scripts_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"
+    )
+    sys.path.insert(0, scripts_dir)
+    try:
+        import chaos_campaign
+    finally:
+        try:
+            sys.path.remove(scripts_dir)
+        except ValueError:
+            pass
+    quick = "--quick" in argv
+    t0 = time.time()
+    report = chaos_campaign.run_campaign(
+        n_trials=30 if quick else 60, n_workers=2, quick=quick
+    )
+    queue_phase = report["phases"][0]
+    device_phase = report["phases"][1]
+    out = {
+        "metric": "chaos_smoke",
+        "value": report["total_injected"],
+        "unit": "injected_faults",
+        "ok": report["ok"],
+        "queue_ok": queue_phase["ok"],
+        "device_ok": device_phase["ok"],
+        "stranded": queue_phase["stranded_running"]
+        + queue_phase["stranded_locks"],
+        "worker_respawns": queue_phase["worker_respawns"],
+        "best_matches_fault_free": queue_phase["best_matches_fault_free"]
+        and device_phase["best_matches_fault_free"],
+        "elapsed_s": round(time.time() - t0, 2),
+    }
+    print(json.dumps(out))
+    return 0 if report["ok"] else 1
+
+
 def main():
     if "--wallclock" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--wallclock"]
@@ -464,6 +510,9 @@ def main():
     if "--lint" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--lint"]
         return lint_section(argv)
+    if "--chaos" in sys.argv[1:]:
+        argv = [a for a in sys.argv[1:] if a != "--chaos"]
+        return chaos_section(argv)
     _ensure_live_backend()
     t_setup = time.time()
     import jax

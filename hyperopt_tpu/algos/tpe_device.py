@@ -540,6 +540,21 @@ def device_history_for(trials, space, mesh=None):
     return dh
 
 
+def reset_device_state():
+    """Drop every device-resident cache this module holds: the
+    DeviceHistory mirrors (per trials/space) and the jitted-program
+    executable cache.
+
+    Called by :class:`hyperopt_tpu.resilience.device.DeviceRecovery`
+    after an XLA/TPU runtime error (preemption, OOM, disconnect): the
+    cached buffers and executables may pin the failed device, and the
+    host-side ``_TrialsHistory`` remains the source of truth — the next
+    suggest rebuilds everything from it (one full re-upload, the same
+    cost as a bucket-boundary rebuild)."""
+    _cache.clear()
+    _jit_cache.clear()
+
+
 # ---------------------------------------------------------------------
 # Fused family programs
 # ---------------------------------------------------------------------
@@ -886,7 +901,15 @@ def multi_family_suggest_async(requests):
     flat_dev = fn([args for _, args, _ in requests])
 
     def resolve():
-        flat = np.asarray(flat_dev)  # the ONE blocking readback
+        try:
+            flat = np.asarray(flat_dev)  # the ONE blocking readback
+        except Exception as e:
+            # async dispatch defers device execution errors to this
+            # readback — tag it so the recovery layer (resilience.device)
+            # recognizes a device-plane failure whatever its type
+            from ..resilience.device import mark_device_error
+
+            raise mark_device_error(e)
         outs, off = [], 0
         for kind, args, st in requests:
             L, k = args[0].shape[0], st["k"]

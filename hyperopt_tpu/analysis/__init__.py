@@ -71,6 +71,11 @@ RACE_LINT_FILES = (
     os.path.join(_PKG_ROOT, "pipeline.py"),
     os.path.join(_PKG_ROOT, "parallel", "file_trials.py"),
     os.path.join(_PKG_ROOT, "parallel", "jax_trials.py"),
+    # the fault-tolerance layer: reaper/recovery/chaos state is touched
+    # from driver, worker, and reaper threads concurrently
+    os.path.join(_PKG_ROOT, "resilience", "leases.py"),
+    os.path.join(_PKG_ROOT, "resilience", "device.py"),
+    os.path.join(_PKG_ROOT, "resilience", "chaos.py"),
 )
 
 

@@ -709,6 +709,8 @@ class Trials:
         trials_save_file="",
         points_to_evaluate=None,
         max_speculation=None,
+        retry_policy=None,
+        fault_stats=None,
     ):
         """Minimize ``fn`` over ``space`` using this store (see ``fmin``)."""
         from .fmin import fmin as _fmin  # local import: avoid circularity
@@ -733,6 +735,8 @@ class Trials:
             early_stop_fn=early_stop_fn,
             trials_save_file=trials_save_file,
             max_speculation=max_speculation,
+            retry_policy=retry_policy,
+            fault_stats=fault_stats,
         )
 
 

@@ -26,9 +26,14 @@ from __future__ import annotations
 import json
 import logging
 import os
+import pickle
 
 from .base import SONify, Trials, trials_from_docs
-from .parallel.file_trials import _json_default, _json_object_hook
+from .parallel.file_trials import (
+    _atomic_write,
+    _json_default,
+    _json_object_hook,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -36,6 +41,14 @@ logger = logging.getLogger(__name__)
 def is_orbax_path(path) -> bool:
     """fmin's dispatch rule for ``trials_save_file``."""
     return bool(path) and str(path).endswith(".orbax")
+
+
+def atomic_pickle_dump(obj, path, protocol=-1):
+    """Crash-safe pickle for the legacy ``trials_save_file`` path:
+    temp file → flush → fsync → atomic rename (the queue's
+    ``_atomic_write`` primitive).  A crash mid-save leaves the previous
+    checkpoint intact instead of a torn pickle that loses the run."""
+    _atomic_write(path, pickle.dumps(obj, protocol=protocol))
 
 
 class TrialsCheckpointer:
@@ -97,20 +110,61 @@ class TrialsCheckpointer:
         self._last_fingerprint = fp
         return True
 
+    def _restore_step(self, step: int):
+        """One step's decoded docs; raises on a corrupted/torn step."""
+        payload = self.manager.restore(
+            step, args=self._ocp.args.JsonRestore()
+        )
+        if not isinstance(payload, dict) or "docs" not in payload:
+            raise ValueError(
+                f"step {step}: malformed checkpoint payload "
+                f"({type(payload).__name__}, no 'docs')"
+            )
+        return self._decode(payload["docs"])
+
     def restore(self, step: int | None = None, into: Trials | None = None):
         """Latest (or given) step; None if the directory has no steps.
+
+        When no explicit ``step`` is requested and the latest step turns
+        out to be corrupted or torn (a crash mid-finalization, a
+        truncated filesystem, a poisoned payload), restore falls back to
+        the previous retained steps in descending order instead of
+        raising — losing one save interval beats losing the run.  An
+        explicitly requested ``step`` still raises on corruption (the
+        caller asked for that step, not "the newest readable one").
 
         ``into``: an EMPTY ``Trials`` (sub)instance to refill — preserves
         the caller's trials subclass and attachments, which a fresh
         ``trials_from_docs`` cannot (fmin's resume path uses this when
         the user passed their own trials object)."""
-        step = self.manager.latest_step() if step is None else int(step)
-        if step is None:
-            return None
-        payload = self.manager.restore(
-            step, args=self._ocp.args.JsonRestore()
-        )
-        docs = self._decode(payload["docs"])
+        if step is not None:
+            docs = self._restore_step(int(step))
+        else:
+            steps = sorted(self.manager.all_steps(), reverse=True)
+            if not steps:
+                return None
+            docs = None
+            last_err = None
+            for s in steps:
+                try:
+                    docs = self._restore_step(s)
+                except Exception as e:
+                    last_err = e
+                    logger.warning(
+                        "orbax restore: step %d unreadable (%s); falling "
+                        "back to the previous retained step", s, e,
+                    )
+                else:
+                    step = s
+                    if s != steps[0]:
+                        logger.warning(
+                            "orbax restore: recovered from retained step "
+                            "%d (latest step %d was corrupted)",
+                            s, steps[0],
+                        )
+                    break
+            if docs is None:
+                raise last_err
         if into is not None:
             if len(into.trials):
                 logger.warning(

@@ -118,10 +118,13 @@ class FMinIter:
         trials_save_file="",
         orbax_ckpt=None,
         max_speculation=None,
+        retry_policy=None,
+        fault_stats=None,
     ):
         self.algo = algo
         self.domain = domain
         self.trials = trials
+        self.retry_policy = retry_policy
         if max_speculation is None:
             max_speculation = _default_max_speculation()
         self.max_speculation = max_speculation
@@ -153,12 +156,52 @@ class FMinIter:
             if is_orbax_path(trials_save_file):
                 # direct FMinIter construction (no fmin() wrapper)
                 self._orbax_ckpt = TrialsCheckpointer(trials_save_file)
-        from .observability import PhaseTimings, SpeculationStats
+        from .observability import FaultStats, PhaseTimings, SpeculationStats
 
         self.timings = PhaseTimings()
         self.speculation_stats = SpeculationStats()
+        self.fault_stats = fault_stats if fault_stats is not None else FaultStats()
+        from .resilience.device import DeviceRecovery
+
+        # wraps every suggest-program dispatch: XLA/TPU runtime errors
+        # trigger bounded re-initialization, then a CPU-backend fallback
+        # (see hyperopt_tpu.resilience.device) — the run survives device
+        # preemption instead of aborting
+        self.device_recovery = DeviceRecovery(stats=self.fault_stats)
 
         if self.asynchronous:
+            if self.retry_policy is not None:
+                # out-of-process workers inherit the driver's retry
+                # policy through this attachment (backoff, timeouts,
+                # lease TTL, attempt budget all agree across the run)
+                try:
+                    trials.attachments["FMinIter_RetryPolicy"] = (
+                        self.retry_policy.to_json()
+                    )
+                except Exception:
+                    logger.info(
+                        "could not persist retry policy attachment; "
+                        "workers fall back to their own defaults",
+                        exc_info=True,
+                    )
+                if getattr(trials, "jobs", None) is not None:
+                    # the policy's lease_ttl IS the run's lease TTL:
+                    # apply it to this queue handle so the reaper's
+                    # expiry clock and stale-lock aging agree with the
+                    # leases workers will grant under the same policy
+                    trials.jobs.lease_ttl = self.retry_policy.lease_ttl
+            else:
+                # a resumed run without a policy must not leave workers
+                # obeying a previous run's attachment
+                try:
+                    del trials.attachments["FMinIter_RetryPolicy"]
+                except KeyError:
+                    pass
+                except Exception:
+                    logger.info(
+                        "could not clear stale retry policy attachment",
+                        exc_info=True,
+                    )
             if "FMinIter_Domain" not in trials.attachments:
                 # out-of-process workers (FileTrials) unpickle the domain
                 # from this attachment; in-process backends (JaxTrials)
@@ -172,7 +215,29 @@ class FMinIter:
                         e,
                     )
 
+    def _evaluate_trial(self, spec, ctrl, trial):
+        """One objective evaluation under the run's retry policy (when
+        set): backoff + deterministic jitter between attempts, per-trial
+        watchdog timeout, :class:`~hyperopt_tpu.resilience.retry.
+        TrialQuarantined` after ``max_attempts`` — which the callers
+        translate to ``JOB_STATE_ERROR`` and keep running (quarantine is
+        the catch, independent of ``catch_eval_exceptions``)."""
+        if self.retry_policy is None:
+            return self.domain.evaluate(spec, ctrl)
+        from .resilience.retry import execute_with_retry
+
+        result, attempts = execute_with_retry(
+            lambda: self.domain.evaluate(spec, ctrl),
+            self.retry_policy,
+            key=trial["tid"],
+            stats=self.fault_stats,
+        )
+        trial["misc"]["attempts"] = attempts
+        return result
+
     def serial_evaluate(self, N=-1):
+        from .resilience.retry import TrialQuarantined
+
         for trial in self.trials._dynamic_trials:
             if trial["state"] == JOB_STATE_NEW:
                 trial["state"] = JOB_STATE_RUNNING
@@ -182,7 +247,18 @@ class FMinIter:
                 spec = spec_from_misc(trial["misc"])
                 ctrl = Ctrl(self.trials, current_trial=trial)
                 try:
-                    result = self.domain.evaluate(spec, ctrl)
+                    result = self._evaluate_trial(spec, ctrl, trial)
+                except TrialQuarantined as e:
+                    # the retry budget is exhausted: quarantine the trial
+                    # (error state excludes it from the TPE fit) and keep
+                    # the run alive — that is the policy's whole point
+                    logger.error("trial %s quarantined: %s", trial["tid"], e)
+                    trial["state"] = JOB_STATE_ERROR
+                    trial["misc"]["attempts"] = e.attempts
+                    trial["misc"]["error"] = (
+                        str(type(e.last_error)), str(e.last_error)
+                    )
+                    trial["refresh_time"] = coarse_utcnow()
                 except Exception as e:
                     logger.error("job exception: %s", str(e))
                     trial["state"] = JOB_STATE_ERROR
@@ -214,6 +290,8 @@ class FMinIter:
         """
         import threading
 
+        from .resilience.retry import TrialQuarantined
+
         for trial in self.trials._dynamic_trials:
             if trial["state"] != JOB_STATE_NEW:
                 continue
@@ -225,9 +303,9 @@ class FMinIter:
             ctrl = Ctrl(self.trials, current_trial=trial)
             box = {}
 
-            def _evaluate(spec=spec, ctrl=ctrl, box=box):
+            def _evaluate(spec=spec, ctrl=ctrl, box=box, trial=trial):
                 try:
-                    box["result"] = self.domain.evaluate(spec, ctrl)
+                    box["result"] = self._evaluate_trial(spec, ctrl, trial)
                 except BaseException as e:
                     box["error"] = e
 
@@ -241,14 +319,18 @@ class FMinIter:
                     # the objective runs; device compute proceeds in
                     # background
                     engine.speculate(limit=budget)
-                except Exception:
+                except Exception as spec_err:
                     # speculation is an optimization — a dispatch failure
                     # (device error, bucket-growth compile OOM) must not
                     # discard the objective's result or wedge the trial
-                    # in RUNNING; drop the speculations and run serially
+                    # in RUNNING; drop the speculations and run serially.
+                    # A device error additionally re-inits through the
+                    # recovery (else the synchronous recompute hits the
+                    # same dead executable).
                     logger.exception(
                         "speculative dispatch failed; continuing serially"
                     )
+                    self.device_recovery.absorb(spec_err)
                     engine.discard()
             finally:
                 # even a non-Exception failure must not abandon the
@@ -261,6 +343,19 @@ class FMinIter:
                     # would not catch it either — propagate unconditionally
                     engine.discard()
                     raise e
+                if isinstance(e, TrialQuarantined):
+                    # retry budget exhausted: quarantine and continue —
+                    # the pending speculations hypothesized this trial
+                    # completing into the above set, so the validity
+                    # check will re-issue them against the error outcome
+                    logger.error("trial %s quarantined: %s", trial["tid"], e)
+                    trial["state"] = JOB_STATE_ERROR
+                    trial["misc"]["attempts"] = e.attempts
+                    trial["misc"]["error"] = (
+                        str(type(e.last_error)), str(e.last_error)
+                    )
+                    trial["refresh_time"] = coarse_utcnow()
+                    continue
                 logger.error("job exception: %s", str(e))
                 trial["state"] = JOB_STATE_ERROR
                 trial["misc"]["error"] = (str(type(e)), str(e))
@@ -341,6 +436,7 @@ class FMinIter:
                     self.rstate,
                     max_speculation=self.max_speculation,
                     stats=self.speculation_stats,
+                    device_recovery=self.device_recovery,
                 )
             engine = self._engine
             if engine.policy == "strict":
@@ -363,6 +459,20 @@ class FMinIter:
                 # be consumed (normal completion leaves none thanks to
                 # the budget cap; early stops / exceptions may)
                 _stack.callback(engine.discard)
+            if self.asynchronous and getattr(self.trials, "jobs", None) is not None:
+                # durable-queue backend (FileTrials): run the lease
+                # reaper for the duration of the run — dead workers'
+                # trials are reclaimed and re-queued automatically, and
+                # torn/stale lock files are GC'd (the automatic
+                # replacement for the never-invoked requeue_stale)
+                from .resilience.leases import LeaseReaper
+
+                reaper = LeaseReaper(
+                    self.trials,
+                    policy=self.retry_policy,
+                    stats=self.fault_stats,
+                )
+                _stack.enter_context(reaper)
             progress_ctx = _stack.enter_context(
                 progress_callback(initial=0, total=N)
             )
@@ -388,12 +498,14 @@ class FMinIter:
                     else:
                         new_ids = trials.new_trial_ids(n_to_enqueue)
                         self.trials.refresh()
+                        seed = self.rstate.integers(2 ** 31 - 1)
                         with self.timings.phase("suggest"):
-                            new_trials = algo(
-                                new_ids,
-                                self.domain,
-                                trials,
-                                self.rstate.integers(2 ** 31 - 1),
+                            # device errors (preemption, OOM, disconnect)
+                            # re-init and retry rather than abort the run
+                            new_trials = self.device_recovery.run(
+                                lambda: algo(
+                                    new_ids, self.domain, trials, seed
+                                )
                             )
                     if new_trials is None:
                         stopped = True
@@ -420,7 +532,7 @@ class FMinIter:
                             # as the serial loop instead of a suggest
                             # barrier
                             engine.speculate(limit=N - n_queued)
-                        except Exception:
+                        except Exception as spec_err:
                             # same contract as the sync plane: a failed
                             # speculative dispatch degrades to the
                             # serial protocol, it doesn't abort the run
@@ -428,6 +540,7 @@ class FMinIter:
                                 "speculative dispatch failed; continuing "
                                 "without prefetch"
                             )
+                            self.device_recovery.absorb(spec_err)
                             engine.discard()
                     # wait for workers to fill in the trials
                     time.sleep(self.poll_interval_secs)
@@ -446,10 +559,16 @@ class FMinIter:
                     if self._orbax_ckpt is not None:
                         self._orbax_ckpt.save(self.trials)
                     else:
-                        with open(self.trials_save_file, "wb") as f:
-                            pickle.dump(
-                                self.trials, f, protocol=self.pickle_protocol
-                            )
+                        # fsync'd write-then-rename: a crash mid-save can
+                        # never tear the checkpoint the next run resumes
+                        # from (see hyperopt_tpu.checkpoint)
+                        from .checkpoint import atomic_pickle_dump
+
+                        atomic_pickle_dump(
+                            self.trials,
+                            self.trials_save_file,
+                            protocol=self.pickle_protocol,
+                        )
                 if self.early_stop_fn is not None:
                     stop, kwargs = self.early_stop_fn(
                         self.trials, *self.early_stop_args
@@ -504,6 +623,7 @@ class FMinIter:
                 self.timings.log_summary(logging.DEBUG)
                 if engine is not None:
                     self.speculation_stats.log_summary(logging.DEBUG)
+                self.fault_stats.log_summary(logging.DEBUG)
             logger.debug("Queue empty, exiting run.")
 
     def exhaust(self):
@@ -534,6 +654,8 @@ def fmin(
     trials_save_file="",
     max_speculation=None,
     validate_space=False,
+    retry_policy=None,
+    fault_stats=None,
 ):
     """Minimize ``fn`` over ``space`` — the reference's full signature.
 
@@ -561,6 +683,26 @@ def fmin(
     per trial; objectives that must run on the main thread (installing
     signal handlers, ``signal.alarm`` timeouts, some GUI/event-loop
     work) need ``max_speculation=0``.
+
+    ``retry_policy``: a :class:`hyperopt_tpu.resilience.RetryPolicy`
+    enabling fault-tolerant trial execution — each trial gets up to
+    ``max_attempts`` executions with exponential backoff and
+    deterministic jitter between them, an optional per-trial
+    ``trial_timeout`` watchdog (distinct from the global ``timeout``
+    above, which bounds the whole run), and quarantine on exhaustion:
+    the trial lands in ``JOB_STATE_ERROR``, is excluded from the TPE
+    fit, and the run continues.  With a FileTrials backend the policy
+    also configures the heartbeat-lease reaper (dead-worker reclamation
+    runs with default settings even when ``retry_policy`` is None) and
+    is published to out-of-process workers through the
+    ``FMinIter_RetryPolicy`` queue attachment.  See
+    ``docs/resilience.md``.
+
+    ``fault_stats``: a shared
+    :class:`~hyperopt_tpu.observability.FaultStats` to record recovery
+    events into (pass one to aggregate driver + worker + chaos
+    accounting across a campaign); by default the driver owns a private
+    instance, exposed as ``FMinIter.fault_stats``.
 
     ``validate_space=True`` runs the static space linter
     (:func:`hyperopt_tpu.analysis.lint_space`) before the first trial:
@@ -650,6 +792,8 @@ def fmin(
             trials_save_file=trials_save_file,
             points_to_evaluate=points_to_evaluate,
             max_speculation=max_speculation,
+            retry_policy=retry_policy,
+            fault_stats=fault_stats,
         )
 
     if trials is None:
@@ -684,6 +828,8 @@ def fmin(
         trials_save_file=trials_save_file,
         orbax_ckpt=orbax_ckpt,
         max_speculation=max_speculation,
+        retry_policy=retry_policy,
+        fault_stats=fault_stats,
     )
     rval.catch_eval_exceptions = catch_eval_exceptions
     try:

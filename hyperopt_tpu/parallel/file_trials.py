@@ -12,6 +12,11 @@ MongoDB deployment, so the durable queue is a directory:
     <queue>/locks/<tid>.lock      reservation: O_CREAT|O_EXCL exclusive
                                   create IS the mutual-exclusion primitive
                                   (the find_one_and_update analog)
+    <queue>/leases/<tid>.lease    renewable heartbeat lease (JSON: owner,
+                                  expiry epoch, attempt) written at
+                                  reservation and renewed by the worker;
+                                  the driver-side reaper reclaims trials
+                                  whose lease expired
     <queue>/attachments/<key>     blob store (GridFS analog) — including
                                   the pickled Domain under
                                   'FMinIter_Domain'
@@ -19,9 +24,12 @@ MongoDB deployment, so the durable queue is a directory:
                                   protected)
 
 Durability semantics match Mongo: re-run fmin with the same queue dir (and
-exp_key) to resume; workers are stateless and restartable at any time; a
-reserved-but-dead worker's job keeps its lock (the reference's known
-behavior — ``owner`` stays set) unless ``requeue_stale`` is called.
+exp_key) to resume; workers are stateless and restartable at any time.
+Recovery goes beyond the reference: a reserved-but-dead worker's job kept
+its lock forever there (``owner`` stays set); here its lease expires and
+the :class:`hyperopt_tpu.resilience.leases.LeaseReaper` re-queues the
+trial automatically (the manual ``requeue_stale`` survives for scripted
+cleanup).
 """
 
 from __future__ import annotations
@@ -33,6 +41,7 @@ import logging
 import os
 import pickle
 import socket
+import sys
 import threading
 import time
 from collections.abc import MutableMapping
@@ -52,6 +61,19 @@ from ..utils import coarse_utcnow
 logger = logging.getLogger(__name__)
 
 _DT_KEY = "$datetime"
+
+# Reservation lease time-to-live.  A worker heartbeats at ttl/3; the
+# driver-side reaper reclaims a RUNNING trial once its lease has been
+# silent this long.  Must comfortably exceed worst-case heartbeat jitter
+# (NFS attribute-cache latency + a descheduled worker thread).
+DEFAULT_LEASE_TTL = 30.0
+
+
+def _active_chaos():
+    """The process-wide chaos monkey, at zero import cost when the chaos
+    harness was never loaded (a sys.modules miss, not an import)."""
+    mod = sys.modules.get("hyperopt_tpu.resilience.chaos")
+    return mod.get_active() if mod is not None else None
 
 
 def _json_default(o):
@@ -100,9 +122,10 @@ def _read_doc(path):
 class FileJobs:
     """Low-level queue operations (the MongoJobs analog)."""
 
-    def __init__(self, root):
+    def __init__(self, root, lease_ttl=DEFAULT_LEASE_TTL):
         self.root = os.path.abspath(root)
-        for sub in ("trials", "locks", "attachments"):
+        self.lease_ttl = float(lease_ttl)
+        for sub in ("trials", "locks", "leases", "attachments"):
             os.makedirs(os.path.join(self.root, sub), exist_ok=True)
         # Process-local gate in FRONT of the cross-process counter file
         # lock: threads of one process queue on a cheap mutex instead of
@@ -122,6 +145,9 @@ class FileJobs:
 
     def lock_path(self, tid):
         return os.path.join(self.root, "locks", f"{int(tid):012d}.lock")
+
+    def lease_path(self, tid):
+        return os.path.join(self.root, "leases", f"{int(tid):012d}.lease")
 
     def attachment_path(self, key):
         safe = key.replace("/", "_").replace(":", "_")
@@ -172,9 +198,16 @@ class FileJobs:
     # -- docs -----------------------------------------------------------
     def insert(self, doc):
         _write_doc(self.trial_path(doc["tid"]), doc)
+        chaos = _active_chaos()
+        if chaos is not None:
+            chaos.maybe_torn_lock(self, doc["tid"])
 
     def write(self, doc):
         _write_doc(self.trial_path(doc["tid"]), doc)
+
+    def read_doc(self, tid):
+        """One trial doc by id (None if absent/unreadable)."""
+        return _read_doc(self.trial_path(tid))
 
     def all_docs(self):
         docs = []
@@ -183,6 +216,71 @@ class FileJobs:
             if doc is not None:
                 docs.append(doc)
         return docs
+
+    def locked_tids(self):
+        """Trial ids with a reservation lock file present."""
+        out = []
+        for p in glob.glob(os.path.join(self.root, "locks", "*.lock")):
+            stem = os.path.basename(p)[: -len(".lock")]
+            try:
+                out.append(int(stem))
+            except ValueError:
+                continue
+        return sorted(out)
+
+    # -- leases ----------------------------------------------------------
+    # Reservations are renewable heartbeat leases: ``reserve`` grants one,
+    # the worker renews it (hyperopt_tpu.resilience.leases.LeaseHeartbeat)
+    # while the objective runs, and the driver-side LeaseReaper reclaims
+    # RUNNING trials whose lease went silent past the TTL.  The lease file
+    # is advisory state *about* the lock, never the mutual-exclusion
+    # primitive itself — the O_CREAT|O_EXCL lock file keeps that role.
+    def grant_lease(self, tid, owner, ttl=None, attempt=1):
+        ttl = self.lease_ttl if ttl is None else float(ttl)
+        now = time.time()
+        _write_doc(
+            self.lease_path(tid),
+            {
+                "owner": owner,
+                "granted_at": now,
+                "expires_at": now + ttl,
+                "attempt": int(attempt),
+            },
+        )
+
+    def read_lease(self, tid):
+        """The lease doc for ``tid`` (None if absent or torn)."""
+        try:
+            with open(self.lease_path(tid), "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return None
+        try:
+            return json.loads(raw.decode())
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return None  # torn write: the reaper treats it as expired
+
+    def renew_lease(self, tid, owner, ttl=None):
+        """Extend ``tid``'s lease iff ``owner`` still holds it; False
+        means the lease was reclaimed (or never granted) and the caller
+        must drop its in-flight result."""
+        lease = self.read_lease(tid)
+        if lease is None or lease.get("owner") != owner:
+            return False
+        ttl = self.lease_ttl if ttl is None else float(ttl)
+        lease["expires_at"] = time.time() + ttl
+        _write_doc(self.lease_path(tid), lease)
+        return True
+
+    def lease_owner(self, tid):
+        lease = self.read_lease(tid)
+        return lease.get("owner") if lease is not None else None
+
+    def clear_lease(self, tid):
+        try:
+            os.unlink(self.lease_path(tid))
+        except FileNotFoundError:
+            pass
 
     # -- fast queue scan (native C++ with Python fallback) ---------------
     def count_states(self):
@@ -208,6 +306,21 @@ class FileJobs:
             return tids
         return [
             doc["tid"] for doc in self.all_docs() if doc["state"] == JOB_STATE_NEW
+        ]
+
+    def running_tids(self):
+        """Trial ids currently in JOB_STATE_RUNNING — the lease reaper's
+        scan primitive (native fast path; the reaper polls every few
+        seconds and must not re-parse the whole queue each time)."""
+        tids = _native.list_state(
+            os.path.join(self.root, "trials"), JOB_STATE_RUNNING
+        )
+        if tids is not None:
+            return tids
+        return [
+            doc["tid"]
+            for doc in self.all_docs()
+            if doc["state"] == JOB_STATE_RUNNING
         ]
 
     @staticmethod
@@ -295,8 +408,14 @@ class FileJobs:
                 # it, and deleting theirs would re-open the double-claim.
                 self._unlock_if_owner(self.lock_path(tid), owner)
                 continue
+            # lease before doc rewrite: the lease must cover the window in
+            # which the doc still reads NEW, or a crash here would strand
+            # a locked trial with nothing for the reaper to expire
+            attempt = int(doc.get("misc", {}).get("attempts", 0)) + 1
+            self.grant_lease(tid, owner, attempt=attempt)
             doc["state"] = JOB_STATE_RUNNING
             doc["owner"] = owner
+            doc.setdefault("misc", {})["attempts"] = attempt
             doc["book_time"] = coarse_utcnow()
             doc["refresh_time"] = coarse_utcnow()
             self.write(doc)
@@ -314,6 +433,7 @@ class FileJobs:
                 continue
             booked = doc.get("book_time")
             if booked is None or (now - booked).total_seconds() > max_age_secs:
+                self.clear_lease(doc["tid"])
                 try:
                     os.unlink(self.lock_path(doc["tid"]))
                 except FileNotFoundError:
@@ -378,8 +498,9 @@ class FileTrials(Trials):
     asynchronous = True
     poll_interval_secs = 0.25
 
-    def __init__(self, queue_dir, exp_key=None, refresh=True):
-        self.jobs = FileJobs(queue_dir)
+    def __init__(self, queue_dir, exp_key=None, refresh=True,
+                 lease_ttl=DEFAULT_LEASE_TTL):
+        self.jobs = FileJobs(queue_dir, lease_ttl=lease_ttl)
         super().__init__(exp_key=exp_key, refresh=False)
         self.attachments = _FileAttachments(self.jobs)
         if refresh:
@@ -406,6 +527,8 @@ class FileTrials(Trials):
         for p in glob.glob(os.path.join(self.jobs.root, "trials", "*.json")):
             os.unlink(p)
         for p in glob.glob(os.path.join(self.jobs.root, "locks", "*.lock")):
+            os.unlink(p)
+        for p in glob.glob(os.path.join(self.jobs.root, "leases", "*.lease")):
             os.unlink(p)
         for k in list(self.attachments):
             del self.attachments[k]

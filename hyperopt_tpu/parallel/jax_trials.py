@@ -126,6 +126,8 @@ class JaxTrials(Trials):
         trials_save_file="",
         points_to_evaluate=None,
         max_speculation=None,
+        retry_policy=None,
+        fault_stats=None,
     ):
         from ..fmin import fmin as _fmin
 
@@ -136,6 +138,12 @@ class JaxTrials(Trials):
         loss_threshold = (
             loss_threshold if loss_threshold is not None else self.loss_threshold
         )
+        if retry_policy is not None and fault_stats is None:
+            # one shared FaultStats across dispatcher threads and the
+            # driver, so retry/quarantine accounting lands in one place
+            from ..observability import FaultStats
+
+            fault_stats = FaultStats()
         state = _JaxFMinState(
             fn,
             space,
@@ -145,6 +153,8 @@ class JaxTrials(Trials):
             device_fn=self.device_fn,
             mesh=self.mesh,
             catch_eval_exceptions=catch_eval_exceptions,
+            retry_policy=retry_policy,
+            fault_stats=fault_stats,
         )
         self._fmin_state = state
         state.start()
@@ -175,6 +185,8 @@ class JaxTrials(Trials):
                     if max_speculation is not None
                     else self.max_speculation
                 ),
+                retry_policy=retry_policy,
+                fault_stats=fault_stats,
             )
         finally:
             state.stop()
@@ -196,12 +208,19 @@ class _JaxFMinState:
         device_fn=None,
         mesh=None,
         catch_eval_exceptions=False,
+        retry_policy=None,
+        fault_stats=None,
     ):
         self.trials = trials
         self.domain = Domain(fn, space)
         self.parallelism = parallelism
         self.trial_timeout = trial_timeout
         self.catch_eval_exceptions = catch_eval_exceptions
+        # hyperopt_tpu.resilience.RetryPolicy for the host-plane worker
+        # threads: backoff retries + per-attempt watchdog + quarantine
+        # (the device batch plane is jit-pure and keeps its own path)
+        self.retry_policy = retry_policy
+        self.fault_stats = fault_stats
         self._device_eval = None
         if device_fn is not None:
             from .sharding import default_mesh, make_sharded_batch_eval
@@ -263,6 +282,25 @@ class _JaxFMinState:
             time.sleep(self.POLL_SECS)
 
     # -- host plane ----------------------------------------------------
+    def _evaluate(self, spec, ctrl, trial):
+        """One objective call, under the retry policy when one is set
+        (backoff + deterministic jitter + per-attempt watchdog;
+        exhaustion raises TrialQuarantined, which the caller's error
+        path lands as JOB_STATE_ERROR — quarantined, run continues)."""
+        if self.retry_policy is None:
+            return self.domain.evaluate(spec, ctrl)
+        from ..resilience.retry import execute_with_retry
+
+        result, attempts = execute_with_retry(
+            lambda: self.domain.evaluate(spec, ctrl),
+            self.retry_policy,
+            key=trial["tid"],
+            stats=self.fault_stats,
+        )
+        with self._mutate_lock:
+            trial["misc"]["attempts"] = attempts
+        return result
+
     def _run_one(self, trial):
         spec = spec_from_misc(trial["misc"])
         ctrl = Ctrl(self.trials, current_trial=trial)
@@ -273,7 +311,9 @@ class _JaxFMinState:
 
                 def target():
                     try:
-                        result_box["result"] = self.domain.evaluate(spec, ctrl)
+                        result_box["result"] = self._evaluate(
+                            spec, ctrl, trial
+                        )
                     except BaseException as e:  # propagated below
                         result_box["error"] = e
 
@@ -294,7 +334,7 @@ class _JaxFMinState:
                     raise result_box["error"]
                 result = result_box["result"]
             else:
-                result = self.domain.evaluate(spec, ctrl)
+                result = self._evaluate(spec, ctrl, trial)
         except Exception as e:
             logger.error("trial %s exception: %s", trial["tid"], e)
             with self._mutate_lock:

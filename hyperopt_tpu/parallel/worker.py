@@ -8,12 +8,33 @@ error → ``JOB_STATE_ERROR``) (~L800-1050) and the
 ``--max-consecutive-failures``, ``--reserve-timeout``, ``--workdir``,
 ``--last-job-timeout``) (~L1050-1300).
 
+Fault tolerance beyond the reference (:mod:`hyperopt_tpu.resilience`):
+
+- every reservation is a renewable **heartbeat lease** — a
+  :class:`~hyperopt_tpu.resilience.leases.LeaseHeartbeat` daemon renews
+  it at poll-interval cadence while the objective runs, so the
+  driver-side reaper can tell a slow worker from a dead one;
+- the final result write re-verifies lease ownership and **drops stale
+  results** (the trial was reclaimed and re-queued while this worker
+  evaluated — writing would clobber the retry);
+- objective exceptions are retried **in place** with the run's
+  :class:`~hyperopt_tpu.resilience.retry.RetryPolicy` (read from the
+  ``FMinIter_RetryPolicy`` queue attachment, overridable per worker),
+  with exponential backoff, deterministic jitter, and a per-attempt
+  watchdog timeout; a trial that exhausts ``max_attempts`` is
+  quarantined in ``JOB_STATE_ERROR``;
+- ``--last-job-timeout`` is enforced *inside* the reserve wait too (the
+  deadline caps the poll loop, so a worker cannot overshoot it by a full
+  ``--reserve-timeout``), and ``--max-consecutive-failures`` ends the
+  daemon with a nonzero exit as documented.
+
 Run one worker per host/slice::
 
     python -m hyperopt_tpu.parallel.worker --queue /shared/q --workdir /tmp/w
 
 Workers are stateless: kill and restart at any time; elasticity falls out
-of the shared queue (SURVEY.md §5).
+of the shared queue (SURVEY.md §5), and killed workers' trials are
+re-queued automatically by the driver's lease reaper.
 """
 
 from __future__ import annotations
@@ -27,10 +48,19 @@ import time
 from timeit import default_timer as timer
 
 from ..base import JOB_STATE_DONE, JOB_STATE_ERROR, spec_from_misc
+from ..observability import FaultStats
 from ..utils import coarse_utcnow, temp_dir, working_dir
-from .file_trials import FileCtrl, FileTrials, default_owner
+from .file_trials import (
+    DEFAULT_LEASE_TTL,
+    FileCtrl,
+    FileTrials,
+    _active_chaos,
+    default_owner,
+)
 
 logger = logging.getLogger(__name__)
+
+RETRY_POLICY_ATTACHMENT = "FMinIter_RetryPolicy"
 
 
 class ReserveTimeout(Exception):
@@ -47,13 +77,32 @@ class FileWorker:
         workdir=None,
         exp_key=None,
         logfilename=None,
+        lease_ttl=None,
+        retry_policy="attachment",
+        stats=None,
     ):
-        self.trials = FileTrials(queue_dir, exp_key=exp_key)
+        # lease_ttl None = defer to the driver's published retry policy
+        # (FMinIter_RetryPolicy attachment), falling back to the queue
+        # default; an explicit value (the --lease-ttl flag) always wins
+        self._explicit_lease_ttl = lease_ttl is not None
+        self.trials = FileTrials(
+            queue_dir, exp_key=exp_key,
+            lease_ttl=lease_ttl if lease_ttl is not None else DEFAULT_LEASE_TTL,
+        )
         self.poll_interval = poll_interval
         self.workdir = workdir
         self.owner = default_owner()
+        self.stats = stats if stats is not None else FaultStats()
         self._domain = None
         self._domain_blob = None
+        # "attachment": read the driver's policy from the queue (re-read
+        # each trial, parsed only when the blob changes — a long-lived
+        # worker spanning several driver runs follows the CURRENT run's
+        # policy); None: never retry in place (pre-resilience behavior);
+        # a RetryPolicy: explicit per-worker override.
+        self._retry_policy_arg = retry_policy
+        self._retry_policy_cache = None
+        self._retry_policy_blob = None
 
     def _load_domain(self):
         blob = self.trials.attachments["FMinIter_Domain"]
@@ -62,70 +111,235 @@ class FileWorker:
             self._domain_blob = blob
         return self._domain
 
-    def run_one(self, host_id=None, reserve_timeout=None, erase_created_workdir=False):
-        """Reserve and execute one trial; raises ReserveTimeout if none."""
+    def _retry_policy(self):
+        if self._retry_policy_arg != "attachment":
+            return self._retry_policy_arg
+        try:
+            blob = self.trials.attachments[RETRY_POLICY_ATTACHMENT]
+        except KeyError:
+            blob = None
+        if blob != self._retry_policy_blob:
+            self._retry_policy_blob = blob
+            if blob is None:
+                self._retry_policy_cache = None
+            else:
+                from ..resilience.retry import RetryPolicy
+
+                try:
+                    self._retry_policy_cache = RetryPolicy.from_json(blob)
+                except Exception:
+                    logger.exception(
+                        "unreadable %s attachment; running without "
+                        "in-place retries", RETRY_POLICY_ATTACHMENT,
+                    )
+                    self._retry_policy_cache = None
+            if (
+                self._retry_policy_cache is not None
+                and not self._explicit_lease_ttl
+            ):
+                # adopt the driver's lease TTL so the heartbeat cadence,
+                # the granted leases, and the reaper's clock all agree
+                self.trials.jobs.lease_ttl = self._retry_policy_cache.lease_ttl
+        return self._retry_policy_cache
+
+    def _finish(self, job, heartbeat, owner):
+        """Ownership-checked terminal write: land the doc and release the
+        reservation, or drop a result whose lease was reclaimed while the
+        objective ran (the trial is already re-queued — writing over it
+        would clobber the retry).  Returns True iff the doc was written.
+
+        Three stale signals are checked, narrowing the inherent TOCTOU
+        window of a filesystem queue (no compare-and-swap) to the
+        read→write gap: the heartbeat noticed the loss, the lease is no
+        longer ours *or has already expired* (a stalled-but-alive worker
+        whose heartbeat thread also stalled must not trust a lease the
+        reaper is entitled to reclaim), or the doc itself was re-owned."""
+        jobs = self.trials.jobs
+        tid = job["tid"]
+        lease = jobs.read_lease(tid)
+        stale = (
+            heartbeat.lost
+            or lease is None
+            or lease.get("owner") != owner
+            or float(lease.get("expires_at", 0)) <= time.time()
+        )
+        if not stale:
+            # the lease read can race the reaper: re-verify the doc is
+            # still stamped with our ownership (a reclaim clears it, a
+            # re-reservation re-stamps another worker's)
+            current = jobs.read_doc(tid)
+            stale = current is not None and current.get("owner") != owner
+        if stale:
+            self.stats.record("stale_result_dropped")
+            logger.warning(
+                "trial %s: lease reclaimed or expired while evaluating; "
+                "dropping this worker's result", tid,
+            )
+            return False
+        jobs.write(job)
+        jobs.clear_lease(tid)
+        jobs._unlock_if_owner(jobs.lock_path(tid), owner)
+        return True
+
+    def run_one(self, host_id=None, reserve_timeout=None,
+                erase_created_workdir=False, deadline=None):
+        """Reserve and execute one trial; raises ReserveTimeout if none.
+
+        ``deadline``: absolute ``timer()`` value past which the reserve
+        wait gives up (the CLI's --last-job-timeout enforcement)."""
+        from ..resilience.leases import LeaseHeartbeat
+        from ..resilience.retry import execute_with_retry
+
         start = timer()
+        owner = host_id or self.owner
         job = None
         while job is None:
-            job = self.trials.jobs.reserve(host_id or self.owner)
+            job = self.trials.jobs.reserve(owner)
             if job is None:
-                if reserve_timeout is not None and timer() - start > reserve_timeout:
+                now = timer()
+                if reserve_timeout is not None and now - start > reserve_timeout:
                     raise ReserveTimeout(
                         f"no job within {reserve_timeout}s at {self.trials.jobs.root}"
                     )
+                if deadline is not None and now > deadline:
+                    raise ReserveTimeout(
+                        f"--last-job-timeout deadline reached at "
+                        f"{self.trials.jobs.root}"
+                    )
                 time.sleep(self.poll_interval)
 
-        logger.info("worker %s reserved trial %s", self.owner, job["tid"])
+        tid = job["tid"]
+        logger.info("worker %s reserved trial %s (attempt %s)",
+                    owner, tid, job["misc"].get("attempts", 1))
         spec = spec_from_misc(job["misc"])
         ctrl = FileCtrl(self.trials, job)
+        policy = self._retry_policy()
+        chaos = _active_chaos()
+        ttl = self.trials.jobs.lease_ttl
+        heartbeat = LeaseHeartbeat(
+            self.trials.jobs, tid, owner, ttl=ttl,
+            interval=min(self.poll_interval, ttl / 3.0),
+            stats=self.stats,
+        ).start()
         try:
-            domain = self._load_domain()
-            workdir = self.workdir or os.path.join(
-                self.trials.jobs.root, "workdir", str(job["tid"])
-            )
-            with temp_dir(workdir, erase_after=erase_created_workdir), working_dir(
-                workdir
-            ):
-                result = domain.evaluate(spec, ctrl)
-        except Exception as e:
-            logger.error("trial %s failed: %s", job["tid"], e)
-            job["state"] = JOB_STATE_ERROR
-            job["misc"]["error"] = (str(type(e)), str(e))
+            # chaos kill points sit OUTSIDE the error-writing try below:
+            # a killed worker must leave the doc RUNNING and the lock in
+            # place, exactly like a SIGKILL'd process — recovery is the
+            # reaper's job, not this (dead) worker's
+            if chaos is not None:
+                chaos.maybe_kill_worker(tid, "pre")
+
+            try:
+                domain = self._load_domain()
+                workdir = self.workdir or os.path.join(
+                    self.trials.jobs.root, "workdir", str(tid)
+                )
+
+                def _evaluate():
+                    return domain.evaluate(spec, ctrl)
+
+                # the workdir chdir wraps the WHOLE retry loop on this
+                # thread, not the per-attempt watchdog thread: an
+                # abandoned (timed-out) attempt must never chdir the
+                # process out from under a live retry, and the temp-dir
+                # cleanup must never delete the directory a later
+                # attempt is executing in
+                with temp_dir(workdir, erase_after=erase_created_workdir), \
+                        working_dir(workdir):
+                    if policy is None:
+                        result = _evaluate()
+                    else:
+                        def _on_retry(attempt, err):
+                            # checkpoint the attempt counter so a crash
+                            # mid-backoff doesn't reset the budget, and
+                            # keep the lease warm through the sleep
+                            job["misc"]["attempts"] = attempt + 1
+                            job["refresh_time"] = coarse_utcnow()
+                            self.trials.jobs.write(job)
+                            heartbeat.renew_now()
+
+                        result, attempts = execute_with_retry(
+                            _evaluate,
+                            policy,
+                            key=tid,
+                            stats=self.stats,
+                            first_attempt=int(job["misc"].get("attempts", 1)),
+                            on_retry=_on_retry,
+                        )
+                        job["misc"]["attempts"] = attempts
+            except Exception as e:
+                logger.error("trial %s failed: %s", tid, e)
+                job["state"] = JOB_STATE_ERROR
+                job["misc"]["error"] = (str(type(e)), str(e))
+                job["refresh_time"] = coarse_utcnow()
+                self._finish(job, heartbeat, owner)
+                raise
+            if chaos is not None:
+                chaos.maybe_kill_worker(tid, "post")
+                if chaos.should_delay_result(tid):
+                    # model a frozen worker process: the heartbeat
+                    # stalls WITH the result write, so past the TTL the
+                    # reaper reclaims the trial and _finish drops this
+                    # (now stale) result
+                    heartbeat.stop()
+                    logger.info(
+                        "chaos: stalling worker %.2fs before the result "
+                        "write of trial %s",
+                        chaos.config.delay_seconds, tid,
+                    )
+                    time.sleep(chaos.config.delay_seconds)
+            job["result"] = result
+            job["state"] = JOB_STATE_DONE
             job["refresh_time"] = coarse_utcnow()
-            self.trials.jobs.write(job)
-            raise
-        job["result"] = result
-        job["state"] = JOB_STATE_DONE
-        job["refresh_time"] = coarse_utcnow()
-        self.trials.jobs.write(job)
-        return job
+            wrote = self._finish(job, heartbeat, owner)
+            if wrote and chaos is not None and chaos.should_duplicate_result(tid):
+                # at-least-once delivery: the doc write is idempotent
+                self.trials.jobs.write(job)
+            return job
+        finally:
+            heartbeat.stop()
 
 
 def main_worker_helper(options):
     if options.max_consecutive_failures <= 0:
         raise ValueError("--max-consecutive-failures must be positive")
+    from ..resilience.chaos import WorkerKilled
+
     worker = FileWorker(
         options.queue,
         poll_interval=options.poll_interval,
         workdir=options.workdir,
         exp_key=options.exp_key,
+        lease_ttl=options.lease_ttl,
     )
     consecutive_failures = 0
     n_done = 0
     start = timer()
+    # reference semantics: --last-job-timeout is an absolute deadline
+    # (seconds since worker start) past which no new job is reserved —
+    # enforced both here and inside run_one's reserve wait, so the
+    # worker cannot overshoot it by a full --reserve-timeout
+    deadline = (
+        start + options.last_job_timeout
+        if options.last_job_timeout is not None
+        else None
+    )
     while True:
-        if options.last_job_timeout is not None and (
-            timer() - start > options.last_job_timeout
-        ):
+        if deadline is not None and timer() > deadline:
             logger.info("--last-job-timeout reached, exiting")
             break
         try:
-            worker.run_one(reserve_timeout=options.reserve_timeout)
+            worker.run_one(
+                reserve_timeout=options.reserve_timeout, deadline=deadline
+            )
             consecutive_failures = 0
             n_done += 1
         except ReserveTimeout:
             logger.info("reserve timeout, exiting after %d jobs", n_done)
             break
+        except WorkerKilled:
+            logger.error("worker killed (chaos injection), exiting")
+            return 1
         except Exception as e:
             consecutive_failures += 1
             logger.error(
@@ -164,6 +378,16 @@ def make_parser():
         "--last-job-timeout", type=float, default=None, dest="last_job_timeout"
     )
     p.add_argument("--max-jobs", type=int, default=None, dest="max_jobs")
+    p.add_argument(
+        "--lease-ttl",
+        type=float,
+        default=None,
+        dest="lease_ttl",
+        help="heartbeat lease time-to-live in seconds; the driver reaper "
+        "re-queues this worker's trial if the lease goes silent this long "
+        f"(default: the driver's published retry policy, else "
+        f"{DEFAULT_LEASE_TTL})",
+    )
     return p
 
 

@@ -168,7 +168,7 @@ class SpeculativeSuggestEngine:
     """
 
     def __init__(self, algo, domain, trials, rstate, max_speculation=1,
-                 stats=None):
+                 stats=None, device_recovery=None):
         if max_speculation < 0:
             raise ValueError(f"max_speculation must be >= 0, got {max_speculation}")
         self.algo = algo
@@ -177,6 +177,14 @@ class SpeculativeSuggestEngine:
         self.rstate = rstate
         self.max_speculation = int(max_speculation)
         self.stats = stats if stats is not None else SpeculationStats()
+        # optional hyperopt_tpu.resilience.device.DeviceRecovery: the
+        # engine's SYNCHRONOUS suggest calls (a miss, or the recompute
+        # after a failed speculative readback) run through it so an
+        # XLA/TPU runtime error re-initializes and retries instead of
+        # aborting the run; speculative launches stay unwrapped — their
+        # failures are already degraded to the serial protocol by the
+        # callers, and the recompute lands here anyway
+        self.device_recovery = device_recovery
         self.policy, self.policy_params = _policy_for(algo)
         self._algo_async = _async_variant(algo)
         # The serial driver calls the engine from one thread, but the
@@ -199,6 +207,14 @@ class SpeculativeSuggestEngine:
         self._dispatch_lock = threading.RLock()
         self._pending_lock = threading.Lock()
         self._pending = deque()  # guarded-by: _pending_lock
+        # (ids, seed) pairs whose speculative LAUNCH failed (device
+        # error at dispatch): the serial protocol already consumed the
+        # id allocation and the rstate draw, so they must be re-used —
+        # not redrawn — by the next launch or synchronous suggest, or a
+        # recovered run's trajectory diverges from the fault-free run.
+        # Survives discard(): these are unlaunched protocol state, not
+        # in-flight device work.
+        self._spare = deque()  # guarded-by: _dispatch_lock
 
     # -- snapshot / validation ----------------------------------------
     def _snapshot(self):
@@ -324,9 +340,26 @@ class SpeculativeSuggestEngine:
             stale = list(self._pending)
             self._pending.clear()
         self.stats.record_invalidation(len(stale))
-        for sp in stale:
+        for j, sp in enumerate(stale):
             t0 = time.perf_counter()
-            resolve, snap = self._launch_spec(sp.ids, sp.seed)
+            try:
+                resolve, snap = self._launch_spec(sp.ids, sp.seed)
+            except Exception as launch_err:
+                # re-issue dispatch failed (device error): park this and
+                # every later stale speculation's (ids, seed) in order —
+                # the next launch or synchronous suggest re-uses them, so
+                # the trajectory stays seed-transparent through the fault
+                logger.exception(
+                    "re-issue dispatch failed; falling back to "
+                    "synchronous recompute"
+                )
+                if self.device_recovery is not None:
+                    self.device_recovery.absorb(launch_err)
+                for sp2 in stale[j:]:
+                    # safe: _validate's only callers (speculate,
+                    # next_batch) hold _dispatch_lock around the call
+                    self._spare.append((sp2.ids, sp2.seed))  # lint: disable=RL301
+                break
             with self._pending_lock:
                 self._pending.append(
                     _Speculation(sp.ids, sp.seed, resolve, snap)
@@ -337,6 +370,15 @@ class SpeculativeSuggestEngine:
             )
 
     # -- dispatch ------------------------------------------------------
+    def _call_algo_sync(self, ids, seed):
+        """The serial protocol's exact algo call, under device recovery
+        when the driver provided one."""
+        if self.device_recovery is not None:
+            return self.device_recovery.run(
+                lambda: self.algo(ids, self.domain, self.trials, seed)
+            )
+        return self.algo(ids, self.domain, self.trials, seed)
+
     def _launch(self, ids, seed):
         if self._algo_async is not None:
             return self._algo_async(ids, self.domain, self.trials, seed)
@@ -410,10 +452,23 @@ class SpeculativeSuggestEngine:
                     if len(self._pending) >= cap:
                         break
                 t0 = time.perf_counter()
-                ids = self.trials.new_trial_ids(batch_size)
-                self.trials.refresh()
-                seed = int(self.rstate.integers(2 ** 31 - 1))
-                resolve, snap = self._launch_spec(ids, seed)
+                if self._spare:
+                    # a previous launch failed after the draw: reuse its
+                    # ids and seed (the serial protocol's exact next call)
+                    ids, seed = self._spare.popleft()
+                else:
+                    ids = self.trials.new_trial_ids(batch_size)
+                    self.trials.refresh()
+                    seed = int(self.rstate.integers(2 ** 31 - 1))
+                try:
+                    resolve, snap = self._launch_spec(ids, seed)
+                except Exception:
+                    # dispatch failed (device error, compile OOM): park
+                    # the consumed (ids, seed) for the next attempt so
+                    # the trajectory stays seed-transparent, then let the
+                    # caller degrade to the serial protocol
+                    self._spare.appendleft((ids, seed))
+                    raise
                 with self._pending_lock:
                     self._pending.append(
                         _Speculation(ids, seed, resolve, snap)
@@ -445,7 +500,7 @@ class SpeculativeSuggestEngine:
                 try:
                     out = sp.resolve()
                     self.stats.record_resolve(time.perf_counter() - t0)
-                except Exception:
+                except Exception as readback_err:
                     # JAX defers device-side execution errors to the
                     # readback; a speculation-only failure must not abort
                     # a run that would have completed serially — drop
@@ -456,28 +511,35 @@ class SpeculativeSuggestEngine:
                         "speculative readback failed; recomputing "
                         "synchronously"
                     )
+                    if self.device_recovery is not None:
+                        self.device_recovery.absorb(readback_err)
                     self.discard()
                     t1 = time.perf_counter()
-                    out = self.algo(
-                        sp.ids, self.domain, self.trials, sp.seed
-                    )
+                    out = self._call_algo_sync(sp.ids, sp.seed)
                     self.stats.record_sync(time.perf_counter() - t1)
                 if out is None:
                     return (docs if docs else None), ids
                 docs.extend(out)
                 ids.extend(sp.ids)
             rem = n - len(ids)
-            if rem > 0:
-                fresh = self.trials.new_trial_ids(rem)
-                self.trials.refresh()
-                seed = int(self.rstate.integers(2 ** 31 - 1))
+            while rem > 0:
+                if self._spare and len(self._spare[0][0]) <= rem:
+                    # a launch-failed speculation already consumed these
+                    # ids and this seed — the serial protocol's exact
+                    # next call is to re-use them synchronously
+                    fresh, seed = self._spare.popleft()
+                else:
+                    fresh = self.trials.new_trial_ids(rem)
+                    self.trials.refresh()
+                    seed = int(self.rstate.integers(2 ** 31 - 1))
                 t0 = time.perf_counter()
-                out = self.algo(fresh, self.domain, self.trials, seed)
+                out = self._call_algo_sync(fresh, seed)
                 self.stats.record_sync(time.perf_counter() - t0)
                 if out is None:
                     return (docs if docs else None), ids + fresh
                 docs.extend(out)
                 ids.extend(fresh)
+                rem = n - len(ids)
             return docs, ids
 
     def discard(self):

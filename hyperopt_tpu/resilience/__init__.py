@@ -1,0 +1,54 @@
+"""Fault-tolerant trial execution.
+
+The reference's distributed model (Bergstra, Yamins & Cox, ICML 2013)
+assumes workers die and does nothing about it: MongoDB leaves a dead
+worker's job reserved forever, and the FileTrials port faithfully
+reproduced that flaw — ``requeue_stale`` existed but nothing called it.
+This package is the recovery story the production north-star requires,
+spanning four layers:
+
+- :mod:`.retry` — per-trial retry policy: exponential backoff with
+  deterministic jitter, per-trial objective timeouts (watchdog thread,
+  distinct from ``fmin``'s global ``timeout``), and poison-trial
+  quarantine (after ``max_attempts`` a trial lands in
+  ``JOB_STATE_ERROR`` and is excluded from the TPE fit instead of
+  poisoning it or killing the run).
+- :mod:`.leases` — FileTrials reservations become renewable heartbeat
+  leases; a driver-side :class:`~.leases.LeaseReaper` automatically
+  reclaims expired leases with attempt counters, replacing the
+  never-invoked manual ``requeue_stale``.
+- :mod:`.device` — XLA/TPU runtime errors (preemption, OOM, disconnect)
+  around the fused suggest-program dispatch trigger bounded
+  re-initialization and a CPU-backend fallback that continues the run.
+- :mod:`.chaos` — deterministic, seed-reproducible fault injection
+  (worker kills, torn locks, delayed/duplicated results, objective
+  exceptions/NaNs/hangs, synthetic device errors) for tests and
+  ``scripts/chaos_campaign.py``.
+
+All recovery events flow into :class:`hyperopt_tpu.observability.FaultStats`
+counters; see ``docs/resilience.md`` for the protocols and knobs.
+"""
+
+from .device import DeviceRecovery, SyntheticDeviceError, is_device_error
+from .leases import LeaseReaper
+from .retry import (
+    RetryPolicy,
+    TrialQuarantined,
+    TrialTimeout,
+    backoff_delay,
+    execute_with_retry,
+    run_with_timeout,
+)
+
+__all__ = [
+    "DeviceRecovery",
+    "LeaseReaper",
+    "RetryPolicy",
+    "SyntheticDeviceError",
+    "TrialQuarantined",
+    "TrialTimeout",
+    "backoff_delay",
+    "execute_with_retry",
+    "is_device_error",
+    "run_with_timeout",
+]

@@ -1,0 +1,260 @@
+"""Seeded chaos harness: deterministic, reproducible fault injection.
+
+Testing a fault-tolerance layer against real faults is flaky by
+construction; this harness makes the faults themselves reproducible.
+Every injection decision is a pure function of
+``(seed, site, key, occurrence)`` — SHA-256 hashed to a uniform draw
+compared against the site's probability — where ``occurrence`` counts
+how many times that exact ``(site, key)`` has rolled.  Re-running a
+campaign with the same seed therefore injects the same faults at the
+same logical points (trial 7's first execution, the third device
+dispatch, ...), regardless of wall-clock timing or which worker thread
+got the job, and a trial that retries after an injected fault rolls a
+*fresh* occurrence — so transient faults stay transient.
+
+Injected fault classes (ISSUE archetype list):
+
+- **worker kill mid-trial** — :class:`WorkerKilled` raised inside
+  ``FileWorker.run_one`` outside its error-writing path: the trial
+  stays RUNNING with its lock and lease in place, exactly like a
+  SIGKILL'd process.  Recovery: lease expiry → reaper reclamation.
+- **torn/stale lock files** — garbage bytes written to a fresh trial's
+  lock path at insert time (a worker that died inside its lock write).
+  Recovery: the reaper's stale-lock GC.
+- **delayed / duplicated results** — a full-process stall (heartbeat
+  paused with the worker, modelling a VM freeze / stop-the-world pause)
+  before, or a second idempotent write after, the worker's final doc
+  write.  Recovery: the lease-ownership/expiry re-check drops genuinely
+  stale writes (when the stall exceeds the TTL the reaper reclaims and
+  re-queues); duplicates are idempotent by construction.
+- **objective exceptions / NaNs / hangs** — raised/returned/slept inside
+  the objective.  Recovery: retry policy (backoff + watchdog timeout),
+  quarantine past ``max_attempts``; NaN losses are NaN-safe in the TPE
+  fit.
+- **synthetic device errors** — :class:`SyntheticDeviceError` raised
+  from a ``tpe_device`` suggest-dispatch observer.  Recovery:
+  :class:`~hyperopt_tpu.resilience.device.DeviceRecovery` re-init / CPU
+  fallback; the speculative engine discards and re-issues cleanly.
+
+Activate with :func:`active` (a context manager setting the process-wide
+monkey); the production code paths cost one ``sys.modules`` lookup when
+the harness was never imported.  Every injection is counted in the
+monkey's :class:`~hyperopt_tpu.observability.FaultStats` under
+``chaos_<site>`` keys, which the campaign report reconciles against the
+recovery counters.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import logging
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass
+
+from ..observability import FaultStats
+from .device import SyntheticDeviceError
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerKilled(Exception):
+    """Chaos-injected worker death: propagate without touching the
+    queue (the trial must look exactly like its worker was SIGKILL'd)."""
+
+
+class ChaosObjectiveError(RuntimeError):
+    """Chaos-injected transient objective failure."""
+
+
+@dataclass(frozen=True)
+class ChaosConfig:
+    """Per-site injection probabilities (0 disables a site) + the seed.
+
+    ``hang_seconds`` should exceed the run's ``trial_timeout`` for hangs
+    to be *observable* faults; ``delay_seconds`` should exceed the lease
+    TTL for delays to exercise the stale-result drop (below it they are
+    harmless slow writes)."""
+
+    seed: int = 0
+    p_worker_kill: float = 0.0
+    p_torn_lock: float = 0.0
+    p_result_delay: float = 0.0
+    p_result_duplicate: float = 0.0
+    p_objective_error: float = 0.0
+    p_objective_nan: float = 0.0
+    p_objective_hang: float = 0.0
+    p_device_error: float = 0.0
+    hang_seconds: float = 1.0
+    delay_seconds: float = 0.5
+
+
+def stable_key(cfg) -> str:
+    """Deterministic key for an objective's config dict (the same
+    suggested point maps to the same key in every run)."""
+    if isinstance(cfg, dict):
+        return repr(sorted((str(k), repr(v)) for k, v in cfg.items()))
+    return repr(cfg)
+
+
+class ChaosMonkey:
+    """One seeded fault-injection schedule + its accounting."""
+
+    # lock-order: _roll_lock
+    def __init__(self, config: ChaosConfig, stats: FaultStats | None = None):
+        self.config = config
+        self.stats = stats if stats is not None else FaultStats()
+        self._roll_lock = threading.Lock()
+        self._occurrence = defaultdict(int)  # guarded-by: _roll_lock
+        self._installed_observer = None
+
+    # -- the deterministic roll ----------------------------------------
+    def _roll(self, site: str, key, p: float) -> bool:
+        if p <= 0.0:
+            return False
+        with self._roll_lock:
+            occ = self._occurrence[(site, key)]
+            self._occurrence[(site, key)] = occ + 1
+        h = hashlib.sha256(
+            f"{self.config.seed}:{site}:{key}:{occ}".encode()
+        ).digest()
+        hit = int.from_bytes(h[:8], "big") / 2 ** 64 < p
+        if hit:
+            self.stats.record(f"chaos_{site}")
+        return hit
+
+    # -- worker-plane sites --------------------------------------------
+    def maybe_kill_worker(self, tid, where: str = "mid"):
+        """Raise :class:`WorkerKilled` per the schedule.  ``where``
+        distinguishes kill points (before vs. after the objective) so
+        each rolls independently."""
+        if self._roll("worker_kill", (int(tid), where),
+                      self.config.p_worker_kill):
+            logger.info("chaos: killing worker at trial %s (%s)", tid, where)
+            raise WorkerKilled(f"chaos kill at trial {tid} ({where})")
+
+    def should_delay_result(self, tid) -> bool:
+        """Roll the result_delay site.  The WORKER implements the stall
+        (pausing its heartbeat for the sleep) so the fault models a
+        frozen process — otherwise the heartbeat thread would keep the
+        lease warm and a delay could never exercise the stale-result
+        drop, however long."""
+        return self._roll("result_delay", int(tid),
+                          self.config.p_result_delay)
+
+    def should_duplicate_result(self, tid) -> bool:
+        return self._roll(
+            "result_duplicate", int(tid), self.config.p_result_duplicate
+        )
+
+    # -- queue-plane sites ---------------------------------------------
+    def maybe_torn_lock(self, jobs, tid):
+        """Write garbage to ``tid``'s lock path (iff currently unlocked):
+        a worker that died inside its lock write."""
+        if not self._roll("torn_lock", int(tid), self.config.p_torn_lock):
+            return
+        import os
+
+        lock = jobs.lock_path(tid)
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return
+        with os.fdopen(fd, "wb") as f:
+            f.write(b"\x00torn\x00")  # never a valid owner string
+        logger.info("chaos: tore lock file for trial %s", tid)
+
+    # -- objective-plane sites -----------------------------------------
+    def objective_fault(self, key):
+        """Inject at one objective evaluation.  May sleep (hang), raise
+        (:class:`ChaosObjectiveError`), or return ``float('nan')`` to be
+        used as the loss; returns None when nothing fired."""
+        if self._roll("objective_hang", key, self.config.p_objective_hang):
+            logger.info("chaos: hanging objective (%.2fs)",
+                        self.config.hang_seconds)
+            time.sleep(self.config.hang_seconds)
+        if self._roll("objective_error", key, self.config.p_objective_error):
+            raise ChaosObjectiveError(f"chaos objective error at {key!r}")
+        if self._roll("objective_nan", key, self.config.p_objective_nan):
+            return float("nan")
+        return None
+
+    def wrap_objective(self, fn):
+        """In-process convenience: ``fn`` with faults injected per point.
+        (Out-of-process workers can't unpickle a closure — they call
+        :func:`objective_fault` from a module-level objective instead.)"""
+
+        def chaotic(cfg):
+            fault = self.objective_fault(stable_key(cfg))
+            if fault is not None:
+                return fault
+            return fn(cfg)
+
+        return chaotic
+
+    # -- device-plane site ---------------------------------------------
+    def maybe_device_error(self):
+        """Roll the device-error site once (one suggest dispatch)."""
+        if self._roll("device_error", "dispatch", self.config.p_device_error):
+            raise SyntheticDeviceError("chaos device error at dispatch")
+
+    def install_device_faults(self):
+        """Register a ``tpe_device`` suggest observer that raises
+        :class:`SyntheticDeviceError` per the schedule (undone by
+        :func:`active`'s exit or :meth:`uninstall_device_faults`)."""
+        if self.config.p_device_error <= 0 or self._installed_observer:
+            return
+        from ..algos import tpe_device
+
+        def _observer(requests):
+            self.maybe_device_error()
+
+        tpe_device._suggest_observers.append(_observer)
+        self._installed_observer = _observer
+
+    def uninstall_device_faults(self):
+        if self._installed_observer is None:
+            return
+        from ..algos import tpe_device
+
+        try:
+            tpe_device._suggest_observers.remove(self._installed_observer)
+        except ValueError:
+            pass
+        self._installed_observer = None
+
+
+# -- process-wide activation -------------------------------------------
+#
+# Production call sites (worker.py, file_trials.py) look the monkey up
+# through ``sys.modules`` so a run that never imported the chaos harness
+# pays one dict miss, not an import.
+
+_active_lock = threading.Lock()
+_active_monkey: ChaosMonkey | None = None
+
+
+def get_active() -> ChaosMonkey | None:
+    return _active_monkey
+
+
+@contextlib.contextmanager
+def active(monkey: ChaosMonkey):
+    """Make ``monkey`` the process-wide chaos source for the block (and
+    register its device-fault observer when configured).  Nested
+    activation is refused — overlapping schedules would not be
+    reproducible."""
+    global _active_monkey
+    with _active_lock:
+        if _active_monkey is not None:
+            raise RuntimeError("a chaos monkey is already active")
+        _active_monkey = monkey
+    monkey.install_device_faults()
+    try:
+        yield monkey
+    finally:
+        monkey.uninstall_device_faults()
+        with _active_lock:
+            _active_monkey = None

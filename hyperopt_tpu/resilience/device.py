@@ -1,0 +1,222 @@
+"""Device-failure recovery for the fused suggest-program dispatch.
+
+On real TPUs the runtime errors routinely: preemption of a donated
+slice, HBM OOM from a concurrent tenant, a tunnel disconnect.  JAX
+surfaces all of these as ``XlaRuntimeError``/``JaxRuntimeError`` at
+dispatch or (because dispatch is asynchronous) at the blocking readback.
+The reference has no story here; this module gives the driver one:
+
+1. **Bounded re-initialization** — on a device error the recovery wrapper
+   drops every piece of device-resident state that could pin the dead
+   device (the jit executable cache and the ``DeviceHistory`` mirrors via
+   :func:`hyperopt_tpu.algos.tpe_device.reset_device_state`, plus
+   ``jax.clear_caches()``) and retries the dispatch; the next suggest
+   re-uploads the history from host truth.
+2. **CPU-backend fallback** — after ``max_reinits`` consecutive failures
+   the recovery pins subsequent suggest programs to the host CPU backend
+   (``jax.default_device``), trading suggest speed for run survival; the
+   speculative engine re-issues cleanly because its failed speculations
+   are discarded, never consumed.
+
+Used by ``FMinIter`` (synchronous suggest calls) and the pipelined
+engine (speculative re-issues / synchronous recomputes).  Every event is
+counted in :class:`~hyperopt_tpu.observability.FaultStats`
+(``device_error`` / ``device_reinit`` / ``cpu_fallback``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+logger = logging.getLogger(__name__)
+
+
+class SyntheticDeviceError(RuntimeError):
+    """A chaos-injected device failure (stands in for XlaRuntimeError)."""
+
+
+# Exception type names that the XLA/JAX runtimes raise for device-plane
+# failures.  Matched by name + module prefix, not identity: jaxlib moves
+# these between modules across versions, and the tunnel plugin wraps
+# them.
+_DEVICE_ERROR_NAMES = frozenset(
+    {
+        "XlaRuntimeError",
+        "JaxRuntimeError",
+        "InternalError",
+        "ResourceExhaustedError",
+        "UnavailableError",
+        "AbortedError",
+    }
+)
+_DEVICE_ERROR_MODULE_PREFIXES = ("jaxlib", "jax.")
+
+
+def is_device_error(exc) -> bool:
+    """Is ``exc`` an XLA/TPU runtime failure (or a chaos stand-in)?"""
+    if isinstance(exc, SyntheticDeviceError):
+        return True
+    if getattr(exc, "_hyperopt_device_error", False):
+        return True
+    for klass in type(exc).__mro__:
+        if klass.__name__ in _DEVICE_ERROR_NAMES and (
+            klass.__module__.startswith(_DEVICE_ERROR_MODULE_PREFIXES)
+        ):
+            return True
+    return False
+
+
+def mark_device_error(exc):
+    """Tag ``exc`` so :func:`is_device_error` recognizes it regardless of
+    type — used by dispatch sites that positively know the failure came
+    from the device plane (e.g. the fused-program readback)."""
+    try:
+        exc._hyperopt_device_error = True
+    except Exception:  # extension-type exceptions may reject attributes
+        pass
+    return exc
+
+
+def _reset_device_state():
+    """Drop device-resident caches so retried dispatches rebuild from
+    host truth.  Best-effort: each layer is cleared independently."""
+    try:
+        from ..algos import tpe_device
+
+        tpe_device.reset_device_state()
+    except Exception:
+        logger.debug("tpe_device state reset failed", exc_info=True)
+    try:
+        import jax
+
+        jax.clear_caches()
+    except Exception:
+        logger.debug("jax.clear_caches failed", exc_info=True)
+
+
+def _cpu_device():
+    try:
+        import jax
+
+        cpus = jax.devices("cpu")
+        return cpus[0] if cpus else None
+    except Exception:
+        return None
+
+
+class DeviceRecovery:
+    """Run device-dispatching callables with bounded re-init + fallback.
+
+    One instance per driver run (``FMinIter`` owns it and shares it with
+    the speculative engine).  Thread-safe: the engine's speculation
+    thread and the driver thread may both hit device errors.
+
+    ``max_reinits``: CONSECUTIVE device errors absorbed by
+    re-initialization before the CPU fallback engages — a successful
+    dispatch refills the budget (scattered transient preemptions over a
+    long run each recover; only a persistently dead device escalates).
+    After the fallback, one more device error (now on the CPU backend,
+    i.e. genuinely unrecoverable) propagates.  The fallback itself is
+    sticky: a backend that just preempted is not handed new work.
+    """
+
+    # lock-order: _state_lock
+    def __init__(self, max_reinits: int = 2, stats=None):
+        self.max_reinits = int(max_reinits)
+        self.stats = stats
+        self._state_lock = threading.Lock()
+        self._n_reinits = 0  # guarded-by: _state_lock
+        self._on_cpu = False  # guarded-by: _state_lock
+
+    @property
+    def cpu_fallback_active(self) -> bool:
+        with self._state_lock:
+            return self._on_cpu
+
+    @property
+    def n_reinits(self) -> int:
+        with self._state_lock:
+            return self._n_reinits
+
+    def note_success(self):
+        """A dispatch went through: refill the consecutive-failure
+        budget (the CPU fallback stays sticky)."""
+        with self._state_lock:
+            self._n_reinits = 0
+
+    def _record(self, event):
+        if self.stats is not None:
+            self.stats.record(event)
+
+    def absorb(self, exc):
+        """Process one observed device error WITHOUT retrying — for
+        callers that have their own degrade path (the speculative
+        engine drops a failed launch and recomputes synchronously, but
+        the device still needs the re-init or the recompute hits the
+        same dead executable).
+
+        Returns None when ``exc`` is not a device error (caller should
+        re-raise), True when the recovery state advanced (re-init done /
+        CPU fallback engaged — a retry is sensible), False when the
+        budget is exhausted (caller must propagate)."""
+        if not is_device_error(exc):
+            return None
+        self._record("device_error")
+        with self._state_lock:
+            if self._n_reinits < self.max_reinits:
+                self._n_reinits += 1
+                action = "reinit"
+            elif not self._on_cpu and _cpu_device() is not None:
+                self._on_cpu = True
+                action = "cpu"
+            else:
+                action = "exhausted"
+        if action == "exhausted":
+            return False
+        if action == "cpu":
+            self._record("cpu_fallback")
+            logger.error(
+                "device error persisted through %d re-inits; falling "
+                "back to the CPU backend: %s",
+                self.max_reinits,
+                exc,
+            )
+        else:
+            self._record("device_reinit")
+            logger.warning(
+                "device error during suggest dispatch "
+                "(re-initializing, %d/%d): %s",
+                self.n_reinits,
+                self.max_reinits,
+                exc,
+            )
+        _reset_device_state()
+        return True
+
+    def run(self, fn):
+        """``fn()`` with recovery.  Non-device exceptions propagate
+        untouched; device errors trigger re-init (bounded), then the CPU
+        fallback, then propagate."""
+        while True:
+            with self._state_lock:
+                on_cpu = self._on_cpu
+            ctx = None
+            if on_cpu:
+                cpu = _cpu_device()
+                if cpu is not None:
+                    import jax
+
+                    ctx = jax.default_device(cpu)
+            try:
+                if ctx is not None:
+                    with ctx:
+                        out = fn()
+                else:
+                    out = fn()
+            except Exception as e:
+                if not self.absorb(e):  # None (not device) or False
+                    raise
+            else:
+                self.note_success()
+                return out

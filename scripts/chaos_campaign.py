@@ -1,0 +1,437 @@
+"""Seeded chaos campaign: inject faults, prove recovery, emit a report.
+
+The ISSUE-3 acceptance run: under a seeded chaos schedule (worker kills,
+torn locks, delayed/duplicated results, objective errors and hangs,
+synthetic device failures) a CPU ``fmin`` run must **complete**, with
+**zero stranded reservations**, **every injected fault accounted for**
+in ``FaultStats``, and the **best trial equal to the fault-free run's
+best** on the same seed.  This script runs that campaign in two phases
+and writes a JSON report of injected faults vs. recoveries:
+
+- **queue phase** — a FileTrials queue with restartable in-process
+  worker threads (a killed worker respawns, like a supervised process)
+  under ``rand.suggest``: exercises the lease/reaper/retry planes.
+  Suggestions don't read results, so the chaos run's parameter stream is
+  identical to the fault-free run's and best-trial equality is exact.
+- **device phase** — a serial in-process ``fmin`` under ``tpe.suggest``
+  with synthetic device errors injected at suggest dispatch: exercises
+  the DeviceRecovery re-init plane and the speculative engine's
+  seed-transparent re-issue (failed launches park their (ids, seed) for
+  the synchronous recompute, so the recovered trajectory equals the
+  fault-free one trial-for-trial).
+
+Usage::
+
+    python scripts/chaos_campaign.py [--trials 100] [--seed 0]
+        [--workers 3] [--quick] [--out chaos_report.json]
+
+Exit code 0 iff every phase completed, reconciled its fault accounting,
+and matched its fault-free twin.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _flush_chaos_modules():
+    """Ensure chaos hooks see a clean slate (idempotent)."""
+    from hyperopt_tpu.resilience import chaos
+
+    assert chaos.get_active() is None, "campaign started with chaos active"
+
+
+# Module-level objective: FileTrials pickles the Domain by reference, so
+# worker threads must be able to re-import this function — a closure
+# wrapped by the monkey would not unpickle.  It consults the
+# process-wide active monkey itself instead.
+def campaign_objective(cfg):
+    from hyperopt_tpu.resilience import chaos
+
+    monkey = chaos.get_active()
+    if monkey is not None:
+        fault = monkey.objective_fault(chaos.stable_key(cfg))
+        if fault is not None:
+            return fault  # an injected NaN loss
+    x = cfg["x"]
+    y = cfg.get("y", 0.0)
+    return (x - 3.0) ** 2 + 0.1 * (y + 1.0) ** 2
+
+
+def _space():
+    from hyperopt_tpu import hp
+
+    return {
+        "x": hp.uniform("x", -5.0, 5.0),
+        "y": hp.normal("y", 0.0, 2.0),
+    }
+
+
+def _best(trials):
+    """(tid, loss, vals) of the best OK trial."""
+    from hyperopt_tpu.base import JOB_STATE_DONE, STATUS_OK
+
+    best = None
+    for t in trials.trials:
+        if t["state"] != JOB_STATE_DONE:
+            continue
+        r = t["result"]
+        loss = r.get("loss")
+        if r.get("status") != STATUS_OK or loss is None or loss != loss:
+            continue
+        if best is None or loss < best[1]:
+            best = (t["tid"], float(loss), t["misc"]["vals"])
+    return best
+
+
+# ---------------------------------------------------------------------
+# queue phase
+# ---------------------------------------------------------------------
+
+def _run_queue_fmin(qdir, n_trials, seed, n_workers, lease_ttl, policy,
+                    stats, kill_counter=None):
+    """One FileTrials fmin with restartable worker threads; returns
+    (best, trials)."""
+    from hyperopt_tpu import fmin
+    from hyperopt_tpu.algos import rand
+    from hyperopt_tpu.parallel.file_trials import FileTrials
+    from hyperopt_tpu.parallel.worker import FileWorker, ReserveTimeout
+    from hyperopt_tpu.resilience.chaos import WorkerKilled
+
+    trials = FileTrials(qdir, lease_ttl=lease_ttl)
+    stop = threading.Event()
+
+    def supervise(slot):
+        # a supervised worker slot: the worker "process" dies on
+        # WorkerKilled and a fresh one respawns in its place
+        while not stop.is_set():
+            worker = FileWorker(
+                qdir, poll_interval=0.02, lease_ttl=lease_ttl, stats=stats
+            )
+            try:
+                while not stop.is_set():
+                    try:
+                        worker.run_one(reserve_timeout=0.3)
+                    except ReserveTimeout:
+                        continue
+            except WorkerKilled:
+                if kill_counter is not None:
+                    kill_counter.append(slot)
+                continue  # respawn
+            except Exception:
+                time.sleep(0.05)  # queue hiccup; keep the slot alive
+
+    threads = [
+        threading.Thread(target=supervise, args=(i,), daemon=True)
+        for i in range(n_workers)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        fmin(
+            campaign_objective,
+            _space(),
+            algo=rand.suggest,
+            max_evals=n_trials,
+            trials=trials,
+            rstate=np.random.default_rng(seed),
+            retry_policy=policy,
+            fault_stats=stats,
+            show_progressbar=False,
+            verbose=False,
+        )
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+    trials.refresh()
+    return _best(trials), trials
+
+
+def run_queue_phase(n_trials, seed, n_workers, chaos_cfg):
+    from hyperopt_tpu.base import JOB_STATE_ERROR, JOB_STATE_RUNNING
+    from hyperopt_tpu.observability import FaultStats
+    from hyperopt_tpu.resilience import RetryPolicy
+    from hyperopt_tpu.resilience.chaos import ChaosMonkey, active
+
+    lease_ttl = 0.6
+    policy = RetryPolicy(
+        max_attempts=4,
+        backoff_base=0.02,
+        backoff_max=0.2,
+        trial_timeout=0.35,
+        lease_ttl=lease_ttl,
+        seed=seed,
+    )
+
+    # fault-free twin first (same seed, chaos off)
+    ff_dir = tempfile.mkdtemp(prefix="chaos_ff_")
+    try:
+        ff_stats = FaultStats()
+        ff_best, _ = _run_queue_fmin(
+            ff_dir, n_trials, seed, n_workers, lease_ttl, policy, ff_stats
+        )
+    finally:
+        shutil.rmtree(ff_dir, ignore_errors=True)
+
+    # chaos run
+    ch_dir = tempfile.mkdtemp(prefix="chaos_run_")
+    t0 = time.time()
+    try:
+        stats = FaultStats()
+        monkey = ChaosMonkey(chaos_cfg, stats=stats)
+        kills = []
+        with active(monkey):
+            best, trials = _run_queue_fmin(
+                ch_dir, n_trials, seed, n_workers, lease_ttl, policy,
+                stats, kill_counter=kills,
+            )
+        jobs = trials.jobs
+        stranded_running = sum(
+            1 for d in jobs.all_docs() if d["state"] == JOB_STATE_RUNNING
+        )
+        stranded_locks = len(jobs.locked_tids())
+        quarantined = sum(
+            1 for d in jobs.all_docs() if d["state"] == JOB_STATE_ERROR
+        )
+    finally:
+        shutil.rmtree(ch_dir, ignore_errors=True)
+
+    counts = stats.summary()
+    injected = stats.injected()
+    # accounting invariants: every fault class reconciles with a
+    # recovery counter (completion itself proves the rest — fmin's
+    # block_until_done cannot return with an unrecovered trial)
+    reconciliation = {
+        # every kill leaves a RUNNING doc whose lease must expire and be
+        # reclaimed (or quarantined) for the run to have completed
+        "kills_reclaimed": (
+            counts.get("lease_reclaimed", 0)
+            + counts.get("lease_quarantined", 0)
+            >= injected.get("worker_kill", 0)
+        ),
+        # every torn lock blocks its NEW trial until the reaper GC'd it
+        "torn_locks_cleared": (
+            counts.get("stale_lock_cleared", 0)
+            >= injected.get("torn_lock", 0)
+        ),
+        # objective errors/hangs surface as retry-policy failures
+        "objective_faults_retried": (
+            counts.get("trial_failure", 0)
+            + counts.get("stale_result_dropped", 0)
+            >= injected.get("objective_error", 0)
+        ),
+        # a delayed (frozen-worker) result past the TTL must be dropped
+        # by the ownership/expiry re-check, never written over the retry
+        "delayed_results_dropped": (
+            counts.get("stale_result_dropped", 0)
+            >= injected.get("result_delay", 0)
+        ),
+        "zero_stranded": stranded_running == 0 and stranded_locks == 0,
+    }
+    best_match = (
+        best is not None
+        and ff_best is not None
+        and best[0] == ff_best[0]
+        and abs(best[1] - ff_best[1]) < 1e-12
+    )
+    return {
+        "phase": "queue",
+        "n_trials": n_trials,
+        "seed": seed,
+        "n_workers": n_workers,
+        "elapsed_s": round(time.time() - t0, 2),
+        "injected": injected,
+        "counters": counts,
+        "worker_respawns": len(kills),
+        "quarantined": quarantined,
+        "stranded_running": stranded_running,
+        "stranded_locks": stranded_locks,
+        "best": {"tid": best[0], "loss": best[1]} if best else None,
+        "fault_free_best": (
+            {"tid": ff_best[0], "loss": ff_best[1]} if ff_best else None
+        ),
+        "best_matches_fault_free": best_match,
+        "reconciliation": reconciliation,
+        "ok": best_match and all(reconciliation.values()),
+    }
+
+
+# ---------------------------------------------------------------------
+# device phase
+# ---------------------------------------------------------------------
+
+def _run_device_fmin(n_trials, seed, policy, stats):
+    from hyperopt_tpu import Trials, fmin
+    from hyperopt_tpu.algos import tpe
+
+    trials = Trials()
+    fmin(
+        campaign_objective,
+        _space(),
+        algo=tpe.suggest,
+        max_evals=n_trials,
+        trials=trials,
+        rstate=np.random.default_rng(seed),
+        retry_policy=policy,
+        fault_stats=stats,
+        show_progressbar=False,
+        verbose=False,
+    )
+    return _best(trials), trials
+
+
+def run_device_phase(n_trials, seed, chaos_cfg):
+    from hyperopt_tpu.observability import FaultStats
+    from hyperopt_tpu.resilience import RetryPolicy
+    from hyperopt_tpu.resilience.chaos import ChaosConfig, ChaosMonkey, active
+
+    policy = RetryPolicy(
+        max_attempts=4, backoff_base=0.01, backoff_max=0.1, seed=seed
+    )
+
+    ff_stats = FaultStats()
+    ff_best, ff_trials = _run_device_fmin(n_trials, seed, policy, ff_stats)
+
+    # device-plane chaos only: suggest-dispatch faults + objective errors
+    dev_cfg = ChaosConfig(
+        seed=chaos_cfg.seed,
+        p_device_error=chaos_cfg.p_device_error,
+        p_objective_error=chaos_cfg.p_objective_error,
+    )
+    t0 = time.time()
+    stats = FaultStats()
+    monkey = ChaosMonkey(dev_cfg, stats=stats)
+    with active(monkey):
+        best, trials = _run_device_fmin(n_trials, seed, policy, stats)
+
+    counts = stats.summary()
+    injected = stats.injected()
+    # trajectory identity: the recovered run's parameter stream equals
+    # the fault-free run's trial-for-trial (seed-transparent re-issue)
+    vals_equal = len(trials.trials) == len(ff_trials.trials) and all(
+        a["misc"]["vals"] == b["misc"]["vals"]
+        for a, b in zip(trials.trials, ff_trials.trials)
+    )
+    best_match = (
+        best is not None
+        and ff_best is not None
+        and best[0] == ff_best[0]
+        and abs(best[1] - ff_best[1]) < 1e-12
+    )
+    reconciliation = {
+        # every injected device fault was observed by the recovery layer
+        # (counted at absorb/run) and answered with a re-init or CPU
+        # fallback while the budget lasted
+        "device_faults_recovered": (
+            counts.get("device_error", 0)
+            >= injected.get("device_error", 0)
+            and counts.get("device_reinit", 0)
+            + counts.get("cpu_fallback", 0)
+            >= min(injected.get("device_error", 0), 1)
+        ),
+        "objective_faults_retried": (
+            counts.get("trial_failure", 0)
+            >= injected.get("objective_error", 0)
+        ),
+    }
+    return {
+        "phase": "device",
+        "n_trials": n_trials,
+        "seed": seed,
+        "elapsed_s": round(time.time() - t0, 2),
+        "injected": injected,
+        "counters": counts,
+        "trajectory_matches_fault_free": vals_equal,
+        "best": {"tid": best[0], "loss": best[1]} if best else None,
+        "fault_free_best": (
+            {"tid": ff_best[0], "loss": ff_best[1]} if ff_best else None
+        ),
+        "best_matches_fault_free": best_match,
+        "reconciliation": reconciliation,
+        "ok": best_match and vals_equal and all(reconciliation.values()),
+    }
+
+
+# ---------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------
+
+def run_campaign(n_trials=100, seed=0, n_workers=3, quick=False,
+                 device_trials=None):
+    from hyperopt_tpu.resilience.chaos import ChaosConfig
+
+    _flush_chaos_modules()
+    if quick:
+        n_trials = min(n_trials, 30)
+        n_workers = min(n_workers, 2)
+    if device_trials is None:
+        # must clear TPE's n_startup_jobs=20 so device programs dispatch
+        device_trials = 30 if quick else 40
+
+    cfg = ChaosConfig(
+        seed=seed,
+        p_worker_kill=0.06,
+        p_torn_lock=0.05,
+        p_result_delay=0.03,
+        p_result_duplicate=0.05,
+        p_objective_error=0.06,
+        p_objective_hang=0.02,
+        hang_seconds=0.8,  # > trial_timeout: observable as a timeout
+        delay_seconds=1.0,  # > lease_ttl: observable as a stale result
+        p_device_error=0.15,
+    )
+    report = {
+        "campaign": "chaos",
+        "seed": seed,
+        "config": {
+            k: getattr(cfg, k) for k in cfg.__dataclass_fields__
+        },
+        "phases": [
+            run_queue_phase(n_trials, seed, n_workers, cfg),
+            run_device_phase(device_trials, seed, cfg),
+        ],
+    }
+    report["ok"] = all(p["ok"] for p in report["phases"])
+    report["total_injected"] = sum(
+        sum(p["injected"].values()) for p in report["phases"]
+    )
+    return report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trials", type=int, default=100)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--workers", type=int, default=3)
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    report = run_campaign(
+        n_trials=args.trials,
+        seed=args.seed,
+        n_workers=args.workers,
+        quick=args.quick,
+    )
+    print(json.dumps(report, indent=1, default=str))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1, default=str)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

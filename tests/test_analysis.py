@@ -442,6 +442,53 @@ def test_race_lint_catches_seeded_repo_violation():
     assert "RL301" in _rules(diags)
 
 
+def test_race_lint_covers_resilience_package():
+    """The fault-tolerance layer's locks (reaper counters, device
+    recovery state, chaos occurrence counters) are registered with the
+    race pass: the files are in RACE_LINT_FILES, their annotations
+    parse, and a seeded violation is caught (non-vacuous green)."""
+    from hyperopt_tpu.analysis import RACE_LINT_FILES
+
+    resilience_files = {
+        os.path.basename(p)
+        for p in RACE_LINT_FILES
+        if os.sep + "resilience" + os.sep in p
+    }
+    assert {"leases.py", "device.py", "chaos.py"} <= resilience_files
+    # the annotations exist (one guarded field per lock minimum)
+    import ast
+
+    from hyperopt_tpu.analysis.race_lint import _parse_annotations
+
+    guards_by_file = {}
+    for path in RACE_LINT_FILES:
+        if os.sep + "resilience" + os.sep not in path:
+            continue
+        with open(path) as f:
+            src = f.read()
+        n = 0
+        for _cls, spec in _parse_annotations(
+            ast.parse(src), src.splitlines(), path
+        ):
+            n += len(spec.guards)
+        guards_by_file[os.path.basename(path)] = n
+    assert guards_by_file["leases.py"] >= 3  # reaper counters
+    assert guards_by_file["device.py"] >= 2  # reinit count + cpu flag
+    assert guards_by_file["chaos.py"] >= 1  # occurrence counters
+    # seeded violation: strip the reaper counter's lock block -> RL301
+    path = next(p for p in RACE_LINT_FILES if p.endswith("leases.py"))
+    with open(path) as f:
+        src = f.read()
+    mutated = src.replace(
+        "            with self._state_lock:\n"
+        "                self._n_reclaimed += 1\n",
+        "            self._n_reclaimed += 1\n",
+    )
+    assert mutated != src, "reaper counter lock block not found; update test"
+    diags = lint_source(mutated, "leases.py")
+    assert "RL301" in _rules(diags)
+
+
 # ---------------------------------------------------------------------
 # construction-time validation satellites
 # ---------------------------------------------------------------------

@@ -1,13 +1,22 @@
 """Orbax structured trials checkpointing (SURVEY §7 option; the pickle
 trials_save_file path keeps reference semantics and is tested in
-test_fmin.py)."""
+test_fmin.py) + the ISSUE-3 hardening: torn-step fallback and the
+fsync'd atomic pickle path."""
+
+import glob
+import os
+import pickle
 
 import numpy as np
 import pytest
 
 from hyperopt_tpu import Trials, fmin, hp
 from hyperopt_tpu.algos import rand, tpe
-from hyperopt_tpu.checkpoint import TrialsCheckpointer, is_orbax_path
+from hyperopt_tpu.checkpoint import (
+    TrialsCheckpointer,
+    atomic_pickle_dump,
+    is_orbax_path,
+)
 
 
 def _space():
@@ -102,6 +111,70 @@ class TestCheckpointer:
         assert out is mine
         assert isinstance(out, MyTrials)
         assert len(out.trials) == 5
+
+
+class TestRestoreHardening:
+    @staticmethod
+    def _corrupt_step(directory, step):
+        """Tear every payload file of one orbax step (a crash mid-write
+        / truncated filesystem)."""
+        step_dirs = [
+            p for p in glob.glob(os.path.join(directory, "*"))
+            if os.path.isdir(p) and os.path.basename(p).lstrip("0") in
+            (str(step), "" if step == 0 else str(step))
+        ]
+        assert step_dirs, f"no step dir for {step} in {directory}"
+        torn = 0
+        for d in step_dirs:
+            for root, _dirs, files in os.walk(d):
+                for fn in files:
+                    with open(os.path.join(root, fn), "wb") as f:
+                        f.write(b"\x00torn checkpoint\x00")
+                    torn += 1
+        assert torn, "step had no files to corrupt"
+
+    def test_corrupted_latest_step_falls_back(self, tmp_path):
+        path = str(tmp_path / "t.orbax")
+        ckpt = TrialsCheckpointer(path)
+        trials = Trials()
+        for n in (4, 9):
+            fmin(_loss, _space(), algo=rand.suggest, max_evals=n,
+                 trials=trials, rstate=np.random.default_rng(0),
+                 show_progressbar=False, verbose=False)
+            ckpt.save(trials)
+        ckpt.close()
+        steps = TrialsCheckpointer(path).steps()
+        assert len(steps) == 2
+        self._corrupt_step(path, steps[-1])
+        restored = TrialsCheckpointer(path).restore()
+        # fell back to the previous retained step (the 4-trial save)
+        assert restored is not None
+        assert len(restored.trials) == 4
+
+    def test_explicit_step_request_still_raises(self, tmp_path):
+        path = str(tmp_path / "t.orbax")
+        ckpt = TrialsCheckpointer(path)
+        trials = Trials()
+        fmin(_loss, _space(), algo=rand.suggest, max_evals=4, trials=trials,
+             rstate=np.random.default_rng(0), show_progressbar=False,
+             verbose=False)
+        ckpt.save(trials)
+        ckpt.close()
+        step = TrialsCheckpointer(path).steps()[-1]
+        self._corrupt_step(path, step)
+        with pytest.raises(Exception):
+            TrialsCheckpointer(path).restore(step=step)
+
+    def test_atomic_pickle_dump_is_loadable_and_replaces(self, tmp_path):
+        path = str(tmp_path / "trials.pkl")
+        atomic_pickle_dump({"a": 1}, path)
+        with open(path, "rb") as f:
+            assert pickle.load(f) == {"a": 1}
+        atomic_pickle_dump({"b": 2}, path)
+        with open(path, "rb") as f:
+            assert pickle.load(f) == {"b": 2}
+        # no temp litter
+        assert sorted(os.listdir(tmp_path)) == ["trials.pkl"]
 
 
 class TestFminIntegration:
