@@ -403,6 +403,25 @@ def _device_scorer_bench(rtt, cap_b, platform):
     return out, headline
 
 
+def _import_script(name):
+    """Import a module from scripts/ (the bench sections delegate to the
+    standalone campaign scripts so every committed BENCH_*.json artifact
+    is reproducible through bench.py)."""
+    scripts_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"
+    )
+    sys.path.insert(0, scripts_dir)
+    try:
+        return __import__(name)
+    finally:
+        # remove by value: some scripts prepend the repo root at import
+        # time, so pop(0) would strip the wrong entry
+        try:
+            sys.path.remove(scripts_dir)
+        except ValueError:
+            pass
+
+
 def wallclock_section(argv):
     """``python bench.py --wallclock [--quick]``: the wall-clock-to-target
     benchmark for the pipelined suggest engine (BASELINE.md's
@@ -410,20 +429,7 @@ def wallclock_section(argv):
     scripts/bench_walltime.py, which writes BENCH_WALLCLOCK.json; this
     entry point exists so every committed BENCH_*.json artifact is
     reproducible through bench.py."""
-    scripts_dir = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "scripts"
-    )
-    sys.path.insert(0, scripts_dir)
-    try:
-        import bench_walltime
-    finally:
-        # remove by value: bench_walltime itself prepends the repo root
-        # at import time, so pop(0) would strip the wrong entry
-        try:
-            sys.path.remove(scripts_dir)
-        except ValueError:
-            pass
-    return bench_walltime.main(argv)
+    return _import_script("bench_walltime").main(argv)
 
 
 def lint_section(argv):
@@ -467,17 +473,7 @@ def chaos_section(argv):
     with the fault-free twin.  Prints ONE JSON line like the other
     bench sections."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    scripts_dir = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "scripts"
-    )
-    sys.path.insert(0, scripts_dir)
-    try:
-        import chaos_campaign
-    finally:
-        try:
-            sys.path.remove(scripts_dir)
-        except ValueError:
-            pass
+    chaos_campaign = _import_script("chaos_campaign")
     quick = "--quick" in argv
     t0 = time.time()
     report = chaos_campaign.run_campaign(
@@ -503,10 +499,44 @@ def chaos_section(argv):
     return 0 if report["ok"] else 1
 
 
+def serve_section(argv):
+    """``python bench.py --serve [--quick]``: optimization-service smoke —
+    a short seeded multi-study loadgen run on CPU
+    (scripts/serve_loadgen.py): 8 concurrent studies driven through the
+    HTTP server, asserting every study completes, mean batch occupancy
+    > 1.5 suggest-requests/dispatch, and fewer fused device dispatches
+    than device-plane suggest requests.  Prints ONE JSON line like the
+    other bench sections."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    serve_loadgen = _import_script("serve_loadgen")
+    quick = "--quick" in argv
+    t0 = time.time()
+    report = serve_loadgen.run_loadgen(
+        n_studies=8, n_trials=6 if quick else 12
+    )
+    out = {
+        "metric": "serve_smoke",
+        "value": report["mean_batch_occupancy"],
+        "unit": "suggests/dispatch",
+        "ok": report["ok"],
+        "n_dispatches": report["n_dispatches"],
+        "n_batched_suggests": report["n_batched_suggests"],
+        "suggest_p50_ms": report["suggest_p50_ms"],
+        "suggest_p99_ms": report["suggest_p99_ms"],
+        "errors": report["errors"],
+        "elapsed_s": round(time.time() - t0, 2),
+    }
+    print(json.dumps(out))
+    return 0 if report["ok"] else 1
+
+
 def main():
     if "--wallclock" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--wallclock"]
         return wallclock_section(argv)
+    if "--serve" in sys.argv[1:]:
+        argv = [a for a in sys.argv[1:] if a != "--serve"]
+        return serve_section(argv)
     if "--lint" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--lint"]
         return lint_section(argv)

@@ -498,10 +498,18 @@ def _suggest_device(
     mesh=None,
     defer=False,
     pending=None,
+    prepare=False,
 ):
     """The production suggest path: device-resident history, one fused XLA
     program per distribution family, O(k) host↔device traffic per call
     (see :mod:`hyperopt_tpu.algos.tpe_device`).
+
+    ``prepare=True`` builds the fused device request list WITHOUT
+    dispatching and returns ``(requests, finish)`` where
+    ``finish(outs)`` turns the per-family winner arrays into trial docs
+    — the hook the optimization service's continuous-batching scheduler
+    uses to coalesce several studies' suggests into one device program
+    (``tpe_device.multi_study_suggest_async``).
 
     ``defer=True`` launches the fused device program WITHOUT the blocking
     readback and returns a zero-arg resolver producing the trial docs —
@@ -644,6 +652,19 @@ def _suggest_device(
                 ),
             ))
         req_fams.append(fam)
+    def finish_outs(outs):
+        chosen_vals = {}
+        for fam, best in zip(req_fams, outs):
+            best = np.asarray(best)  # [L, k]
+            for i, lb in enumerate(fam.labels):
+                if lb not in hard:
+                    chosen_vals[lb] = fam.from_fit_space(i, best[i])
+        chosen_vals.update(hard)
+        return _emit_docs(new_ids, domain, trials, chosen_vals, k)
+
+    if prepare:
+        return requests, finish_outs
+
     # every family fits/samples/scores in ONE jitted program with ONE
     # flat readback: per-dispatch latency (a network round trip when the
     # chip is tunneled) is paid once per suggest, not once per family,
@@ -651,14 +672,7 @@ def _suggest_device(
     resolve_fetch = td.multi_family_suggest_async(requests)
 
     def finish():
-        chosen_vals = {}
-        for fam, best in zip(req_fams, resolve_fetch()):
-            best = np.asarray(best)  # [L, k]
-            for i, lb in enumerate(fam.labels):
-                if lb not in hard:
-                    chosen_vals[lb] = fam.from_fit_space(i, best[i])
-        chosen_vals.update(hard)
-        return _emit_docs(new_ids, domain, trials, chosen_vals, k)
+        return finish_outs(resolve_fetch())
 
     if defer:
         return finish
@@ -761,10 +775,54 @@ def suggest_async(
     )
 
 
+def suggest_prepare(
+    new_ids,
+    domain,
+    trials,
+    seed,
+    prior_weight=_default_prior_weight,
+    n_startup_jobs=_default_n_startup_jobs,
+    n_EI_candidates=_default_n_EI_candidates,
+    gamma=_default_gamma,
+    linear_forgetting=_default_linear_forgetting,
+    verbose=True,
+    mesh=None,
+    param_locks=None,
+    trial_filter=None,
+):
+    """Build one TPE suggest's fused device request list WITHOUT
+    dispatching it.
+
+    Returns ``(requests, finish)`` — ``requests`` is exactly what
+    :func:`tpe_device.multi_family_suggest_async` takes, and
+    ``finish(outs)`` turns the resolved per-family winner arrays into
+    the same trial docs :func:`suggest` would have returned for these
+    inputs.  Returns ``None`` when this suggest does not reach the
+    device plane at all (random-search startup, empty OK history, or an
+    uncompilable space) — callers then run :func:`suggest` directly,
+    which is host-side and cheap.
+
+    This is the continuous-batching hook of the optimization service
+    (:mod:`hyperopt_tpu.service`): the scheduler prepares several
+    studies' suggests, concatenates their request lists into ONE fused
+    device program (``tpe_device.multi_study_suggest_async``), and
+    finishes each against its slice of the flat readback.  A
+    ``(requests, finish)`` pair prepared this way and resolved through
+    the batched dispatch is bit-identical to the unbatched
+    :func:`suggest` for the same inputs — the winner math reads only
+    this study's own buffers.
+    """
+    return _suggest_impl(
+        new_ids, domain, trials, seed, prior_weight, n_startup_jobs,
+        n_EI_candidates, gamma, linear_forgetting, param_locks,
+        trial_filter, mesh, defer=False, prepare=True,
+    )
+
+
 def _suggest_impl(
     new_ids, domain, trials, seed, prior_weight, n_startup_jobs,
     n_EI_candidates, gamma, linear_forgetting, param_locks, trial_filter,
-    mesh, defer, pending=None,
+    mesh, defer, pending=None, prepare=False,
 ):
     hist = trials.history
     # Startup gate on ALL inserted non-error trials (reference semantics:
@@ -773,10 +831,14 @@ def _suggest_impl(
     # the reference does.  A separate guard keeps random suggest while the
     # OK history is empty (nothing to fit a posterior on).
     if len(trials.trials) < n_startup_jobs or len(hist.losses) == 0:
+        if prepare:
+            return None  # host-side path: no device program to batch
         docs = rand.suggest(new_ids, domain, trials, seed)
         return (lambda: docs) if defer else docs
 
     if not domain.space.compiled:
+        if prepare:
+            return None
         logger.warning(
             "space not compilable (%s): tpe falling back to random suggest",
             domain.space.compile_error,
@@ -803,6 +865,7 @@ def _suggest_impl(
         mesh=mesh,
         defer=defer,
         pending=pending,
+        prepare=prepare,
     )
 
 
@@ -811,3 +874,6 @@ def _suggest_impl(
 # contract any suggest algorithm can opt into (see hyperopt_tpu.pipeline)
 suggest.async_variant = suggest_async
 suggest.speculation_policy = "tpe_quantile"
+# the optimization service's continuous-batching scheduler discovers the
+# prepare/finish split the same way (see hyperopt_tpu.service.core)
+suggest.prepare_variant = suggest_prepare

@@ -920,6 +920,60 @@ def multi_family_suggest_async(requests):
     return resolve
 
 
+def multi_study_suggest_async(groups):
+    """Coalesce SEVERAL suggests' family request lists into ONE fused
+    device program — the continuous-batching primitive of the
+    optimization service (:mod:`hyperopt_tpu.service`).
+
+    ``groups``: list of request lists, each exactly what one
+    :func:`multi_family_suggest_async` call would take (they may come
+    from different studies/Trials — every family core closes over its
+    own buffers, so concatenation is safe).  All groups' families
+    dispatch as ONE jitted program with ONE flat readback; returns one
+    zero-arg resolver per group, each yielding that group's per-family
+    winner arrays.  The underlying readback happens once, on whichever
+    resolver is called first.
+
+    Program reuse: the fused jit cache is keyed on the concatenated
+    static signature, so batches with the same per-study composition
+    (same family statics, same capacity buckets) reuse one executable;
+    a novel composition traces once (the RecompilationAuditor counts
+    these like any other trace).  Group order is CANONICALIZED before
+    concatenation — the jit key depends on request order, so without
+    sorting, the same set of heterogeneous studies arriving as [A, B]
+    in one batch and [B, A] in the next would recompile an identical
+    workload (and grow the executable cache combinatorially).
+    """
+    def canon_key(g):
+        # statics + arg shapes = the jit cache key contribution of one
+        # group; repr gives a total order without comparing the raw
+        # values (statics may hold non-orderable objects)
+        return repr((
+            _multi_sig(g),
+            tuple(
+                tuple(np.shape(a) for a in args) for _, args, _ in g
+            ),
+        ))
+
+    order = sorted(range(len(groups)), key=lambda i: canon_key(groups[i]))
+    flat = [r for i in order for r in groups[i]]
+    resolve_all = multi_family_suggest_async(flat)
+    cell = {}
+
+    def _outs():
+        if "outs" not in cell:
+            cell["outs"] = resolve_all()
+        return cell["outs"]
+
+    spans, off = [None] * len(groups), 0
+    for i in order:
+        spans[i] = (off, off + len(groups[i]))
+        off += len(groups[i])
+    return [
+        (lambda lo=lo, hi=hi: _outs()[lo:hi]) for lo, hi in spans
+    ]
+
+
 def multi_family_suggest(requests):
     """ALL families of one suggest as ONE jitted device program.
 

@@ -76,6 +76,9 @@ RACE_LINT_FILES = (
     os.path.join(_PKG_ROOT, "resilience", "leases.py"),
     os.path.join(_PKG_ROOT, "resilience", "device.py"),
     os.path.join(_PKG_ROOT, "resilience", "chaos.py"),
+    # the optimization service: HTTP handler threads submit/report while
+    # the scheduler thread batches — queue and registry carry guards
+    os.path.join(_PKG_ROOT, "service", "core.py"),
 )
 
 

@@ -20,9 +20,13 @@ logger = logging.getLogger(__name__)
 
 
 class PhaseTimings:
-    """Accumulated wall-clock per driver phase (suggest / evaluate / ...)."""
+    """Accumulated wall-clock per driver phase (suggest / evaluate / ...).
+
+    Thread-safe: the driver loop owns one, but the optimization service
+    records into a shared instance from concurrent handler threads."""
 
     def __init__(self):
+        self._lock = threading.Lock()
         self._total = defaultdict(float)
         self._count = defaultdict(int)
 
@@ -32,22 +36,24 @@ class PhaseTimings:
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            self._total[name] += dt
-            self._count[name] += 1
+            self.record(name, time.perf_counter() - t0)
 
     def record(self, name, seconds):
-        self._total[name] += seconds
-        self._count[name] += 1
+        with self._lock:
+            self._total[name] += seconds
+            self._count[name] += 1
 
     def summary(self):
+        with self._lock:
+            totals = dict(self._total)
+            counts = dict(self._count)
         return {
             name: {
-                "total_s": round(self._total[name], 6),
-                "count": self._count[name],
-                "mean_ms": round(1e3 * self._total[name] / max(self._count[name], 1), 3),
+                "total_s": round(totals[name], 6),
+                "count": counts[name],
+                "mean_ms": round(1e3 * totals[name] / max(counts[name], 1), 3),
             }
-            for name in sorted(self._total)
+            for name in sorted(totals)
         }
 
     def log_summary(self, level=logging.INFO):
@@ -245,6 +251,265 @@ class FaultStats:
             "faults: %s",
             " ".join(f"{k}={v}" for k, v in s.items()),
         )
+
+
+class ServiceStats:
+    """Request / latency / batch-occupancy accounting for the
+    optimization service (:mod:`hyperopt_tpu.service`).
+
+    Tracks, per endpoint, how many requests were served and how many
+    were rejected with backpressure; per study, how many suggests were
+    served; and for the continuous-batching scheduler, how many fused
+    device dispatches ran and how many suggest requests each one
+    carried (``mean_batch_occupancy`` — the "requests per device
+    program" number the service exists to push above 1).  Suggest
+    latencies are kept as a bounded sample for p50/p99.
+
+    Thread-safe: HTTP handler threads and the scheduler thread record
+    concurrently.
+    """
+
+    def __init__(self, max_latency_samples=65536):
+        from collections import deque
+
+        self._lock = threading.Lock()
+        self._requests = defaultdict(int)       # endpoint -> served
+        self._rejected = defaultdict(int)       # endpoint -> 429s
+        self._study_suggests = defaultdict(int)  # study -> suggests served
+        # ring buffer: a long-lived server's quantiles must track the
+        # CURRENT traffic, not freeze on the first N samples
+        self._suggest_latencies = deque(maxlen=int(max_latency_samples))
+        self._n_dispatches = 0        # fused device programs launched
+        self._n_batched = 0           # suggests served through a dispatch
+        self._n_inline = 0            # host-side suggests (startup/rand)
+        self._dispatch_s = 0.0
+        self._queue_depth = 0         # last-observed scheduler queue depth
+        self._n_studies = 0
+
+    def record_request(self, endpoint: str, seconds=None, study=None):
+        with self._lock:
+            self._requests[endpoint] += 1
+            if endpoint == "suggest":
+                if study is not None:
+                    self._study_suggests[str(study)] += 1
+                if seconds is not None:
+                    self._suggest_latencies.append(float(seconds))
+
+    def record_rejection(self, endpoint: str):
+        with self._lock:
+            self._rejected[endpoint] += 1
+
+    def record_dispatch(self, n_requests: int, seconds: float):
+        """One fused device program carrying ``n_requests`` suggests."""
+        with self._lock:
+            self._n_dispatches += 1
+            self._n_batched += int(n_requests)
+            self._dispatch_s += float(seconds)
+
+    def record_inline(self, n: int = 1):
+        """Suggests served host-side (random startup) — no device
+        program, so they count toward requests but not occupancy."""
+        with self._lock:
+            self._n_inline += int(n)
+
+    def set_queue_depth(self, n: int):
+        with self._lock:
+            self._queue_depth = int(n)
+
+    def set_n_studies(self, n: int):
+        with self._lock:
+            self._n_studies = int(n)
+
+    @property
+    def mean_batch_occupancy(self):
+        with self._lock:
+            if not self._n_dispatches:
+                return None
+            return self._n_batched / self._n_dispatches
+
+    def latency_quantiles(self):
+        """{"p50_ms": ..., "p99_ms": ...} over the suggest sample (None
+        values when no suggests were timed yet)."""
+        import numpy as np
+
+        with self._lock:
+            lat = list(self._suggest_latencies)
+        if not lat:
+            return {"p50_ms": None, "p99_ms": None}
+        arr = np.asarray(lat)
+        return {
+            "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 3),
+        }
+
+    def summary(self) -> dict:
+        q = self.latency_quantiles()
+        with self._lock:
+            occ = (
+                self._n_batched / self._n_dispatches
+                if self._n_dispatches
+                else None
+            )
+            return {
+                "requests": dict(sorted(self._requests.items())),
+                "rejected": dict(sorted(self._rejected.items())),
+                "study_suggests": dict(sorted(self._study_suggests.items())),
+                "n_dispatches": self._n_dispatches,
+                "n_batched_suggests": self._n_batched,
+                "n_inline_suggests": self._n_inline,
+                "mean_batch_occupancy": (
+                    round(occ, 4) if occ is not None else None
+                ),
+                "dispatch_s": round(self._dispatch_s, 6),
+                "queue_depth": self._queue_depth,
+                "n_studies": self._n_studies,
+                "suggest_latency": q,
+            }
+
+    def log_summary(self, level=logging.INFO):
+        s = self.summary()
+        logger.log(
+            level,
+            "service: requests=%s rejected=%s dispatches=%d occupancy=%s "
+            "p50=%sms p99=%sms",
+            s["requests"],
+            s["rejected"],
+            s["n_dispatches"],
+            s["mean_batch_occupancy"],
+            s["suggest_latency"]["p50_ms"],
+            s["suggest_latency"]["p99_ms"],
+        )
+
+
+# ---------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------
+
+
+def _prom_escape(value: str) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _prom_value(v) -> str:
+    if v is None:
+        return "NaN"
+    return repr(float(v))
+
+
+def render_prometheus(
+    timings: "PhaseTimings" = None,
+    speculation: "SpeculationStats" = None,
+    faults: "FaultStats" = None,
+    service: "ServiceStats" = None,
+    extra: dict = None,
+    namespace: str = "hyperopt",
+):
+    """Render the observability counters in the Prometheus text
+    exposition format (version 0.0.4) — the payload of the optimization
+    server's ``/metrics`` endpoint, and usable standalone for any run
+    that holds these stats objects.
+
+    Every argument is optional; only the sections passed render.
+    ``extra`` is a flat ``{metric_suffix: scalar}`` dict rendered as
+    gauges (for ad-hoc gauges like process uptime).
+    """
+    lines = []
+
+    def head(name, help_text, kind):
+        lines.append(f"# HELP {namespace}_{name} {help_text}")
+        lines.append(f"# TYPE {namespace}_{name} {kind}")
+
+    def sample(name, labels, value):
+        if labels:
+            lbl = ",".join(
+                f'{k}="{_prom_escape(v)}"' for k, v in sorted(labels.items())
+            )
+            lines.append(f"{namespace}_{name}{{{lbl}}} {_prom_value(value)}")
+        else:
+            lines.append(f"{namespace}_{name} {_prom_value(value)}")
+
+    if timings is not None:
+        summ = timings.summary()
+        head("phase_seconds_total", "Accumulated wall-clock per driver phase.", "counter")
+        for phase, st in summ.items():
+            sample("phase_seconds_total", {"phase": phase}, st["total_s"])
+        head("phase_count_total", "Invocations per driver phase.", "counter")
+        for phase, st in summ.items():
+            sample("phase_count_total", {"phase": phase}, st["count"])
+
+    if speculation is not None:
+        s = speculation.summary()
+        head("speculation_seconds_total",
+             "Pipelined-suggest time split into hidden vs exposed.", "counter")
+        sample("speculation_seconds_total", {"kind": "hidden"}, s["hidden_s"])
+        sample("speculation_seconds_total", {"kind": "exposed"}, s["exposed_s"])
+        head("speculation_events_total",
+             "Pipelined-suggest engine event counts.", "counter")
+        for key in (
+            "n_dispatched", "n_hypothesis", "n_used", "n_invalidated",
+            "n_sync", "n_discarded",
+        ):
+            sample("speculation_events_total", {"event": key[2:]}, s[key])
+
+    if faults is not None:
+        counts = faults.counts()
+        head("fault_events_total",
+             "Fault-tolerance recovery and chaos-injection events.", "counter")
+        for event, n in counts.items():
+            sample("fault_events_total", {"event": event}, n)
+        head("fault_backoff_seconds_total",
+             "Accumulated retry-backoff sleep.", "counter")
+        sample("fault_backoff_seconds_total", None, faults.backoff_s)
+
+    if service is not None:
+        s = service.summary()
+        head("service_requests_total", "Requests served per endpoint.", "counter")
+        for endpoint, n in s["requests"].items():
+            sample("service_requests_total", {"endpoint": endpoint}, n)
+        head("service_rejected_total",
+             "Requests rejected with backpressure per endpoint.", "counter")
+        for endpoint, n in s["rejected"].items():
+            sample("service_rejected_total", {"endpoint": endpoint}, n)
+        head("service_study_suggests_total",
+             "Suggest requests served per study.", "counter")
+        for study, n in s["study_suggests"].items():
+            sample("service_study_suggests_total", {"study": study}, n)
+        head("service_dispatches_total",
+             "Fused device suggest programs launched.", "counter")
+        sample("service_dispatches_total", None, s["n_dispatches"])
+        head("service_batched_suggests_total",
+             "Suggest requests served through a fused dispatch.", "counter")
+        sample("service_batched_suggests_total", None, s["n_batched_suggests"])
+        head("service_inline_suggests_total",
+             "Suggest requests served host-side (startup/random).", "counter")
+        sample("service_inline_suggests_total", None, s["n_inline_suggests"])
+        head("service_batch_occupancy",
+             "Mean suggest requests per fused device dispatch.", "gauge")
+        sample("service_batch_occupancy", None, s["mean_batch_occupancy"])
+        head("service_queue_depth", "Scheduler queue depth (last observed).", "gauge")
+        sample("service_queue_depth", None, s["queue_depth"])
+        head("service_studies", "Registered studies.", "gauge")
+        sample("service_studies", None, s["n_studies"])
+        head("service_suggest_latency_ms",
+             "Suggest latency quantiles over a bounded sample.", "gauge")
+        for q_key, q_name in (("p50_ms", "0.5"), ("p99_ms", "0.99")):
+            sample(
+                "service_suggest_latency_ms",
+                {"quantile": q_name},
+                s["suggest_latency"][q_key],
+            )
+
+    if extra:
+        for key, value in sorted(extra.items()):
+            head(key, "Ad-hoc gauge.", "gauge")
+            sample(key, None, value)
+
+    return "\n".join(lines) + "\n"
 
 
 def timed_suggest(algo, timings: PhaseTimings):

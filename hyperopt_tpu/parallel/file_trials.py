@@ -510,6 +510,19 @@ class FileTrials(Trials):
         self._dynamic_trials = self.jobs.all_docs()
         super().refresh()
 
+    def refresh_local(self):
+        """Recompute the derived views (``_trials``, the SoA history)
+        from the IN-MEMORY docs without re-reading the queue directory.
+
+        For a single-writer owner — the optimization service, which
+        inserts and mutates every doc itself and write-throughs each
+        change via ``jobs.write`` — the in-memory docs are authoritative
+        and the O(N)-file disk scan of :meth:`refresh` per report would
+        dominate the serving hot path.  Multi-writer users (fmin driver
+        + out-of-process workers) must keep calling :meth:`refresh`,
+        which is the only way to observe other processes' writes."""
+        super().refresh()
+
     def _insert_trial_docs(self, docs):
         rval = []
         for doc in docs:

@@ -182,11 +182,17 @@ class FileWorker:
         return True
 
     def run_one(self, host_id=None, reserve_timeout=None,
-                erase_created_workdir=False, deadline=None):
+                erase_created_workdir=False, deadline=None,
+                stop_event=None):
         """Reserve and execute one trial; raises ReserveTimeout if none.
 
         ``deadline``: absolute ``timer()`` value past which the reserve
-        wait gives up (the CLI's --last-job-timeout enforcement)."""
+        wait gives up (the CLI's --last-job-timeout enforcement).
+        ``stop_event``: a ``threading.Event`` that aborts the reserve
+        wait when set (the CLI's graceful-shutdown path: a SIGTERM mid
+        -poll must not strand the worker for a full --reserve-timeout).
+        Once a trial IS reserved the event is ignored — the in-flight
+        trial runs to completion and releases its lock+lease normally."""
         from ..resilience.leases import LeaseHeartbeat
         from ..resilience.retry import execute_with_retry
 
@@ -194,6 +200,8 @@ class FileWorker:
         owner = host_id or self.owner
         job = None
         while job is None:
+            if stop_event is not None and stop_event.is_set():
+                raise ReserveTimeout("shutdown requested during reserve wait")
             job = self.trials.jobs.reserve(owner)
             if job is None:
                 now = timer()
@@ -300,6 +308,47 @@ class FileWorker:
             heartbeat.stop()
 
 
+def _install_graceful_shutdown():
+    """SIGTERM/SIGINT → a threading.Event instead of abrupt death.
+
+    The default dispositions strand state: SIGTERM kills the process
+    mid-objective (the doc stays RUNNING and the lock+lease sit until
+    the reaper expires them), SIGINT raises KeyboardInterrupt at an
+    arbitrary bytecode.  With the handlers installed the worker finishes
+    the in-flight trial (the terminal write releases lock+lease as
+    usual), skips reserving another, and exits 0.  Returns the event,
+    or None when handlers cannot be installed (not the main thread —
+    in-process test workers keep their current behavior)."""
+    import signal
+    import threading
+
+    stop_event = threading.Event()
+
+    def _handler(signum, frame):
+        if stop_event.is_set():
+            # second signal: the operator means it (the in-flight
+            # objective may be hung and nothing else would ever
+            # interrupt it) — restore the default disposition and
+            # re-deliver for the conventional hard exit; the reaper
+            # reclaims the stranded lease
+            logger.warning("second signal %d: exiting immediately", signum)
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+            return
+        logger.info(
+            "signal %d: finishing the in-flight trial, then exiting",
+            signum,
+        )
+        stop_event.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _handler)
+        signal.signal(signal.SIGINT, _handler)
+    except ValueError:
+        return None
+    return stop_event
+
+
 def main_worker_helper(options):
     if options.max_consecutive_failures <= 0:
         raise ValueError("--max-consecutive-failures must be positive")
@@ -312,6 +361,7 @@ def main_worker_helper(options):
         exp_key=options.exp_key,
         lease_ttl=options.lease_ttl,
     )
+    stop_event = _install_graceful_shutdown()
     consecutive_failures = 0
     n_done = 0
     start = timer()
@@ -325,12 +375,17 @@ def main_worker_helper(options):
         else None
     )
     while True:
+        if stop_event is not None and stop_event.is_set():
+            logger.info("shutdown requested, exiting cleanly after %d jobs",
+                        n_done)
+            break
         if deadline is not None and timer() > deadline:
             logger.info("--last-job-timeout reached, exiting")
             break
         try:
             worker.run_one(
-                reserve_timeout=options.reserve_timeout, deadline=deadline
+                reserve_timeout=options.reserve_timeout, deadline=deadline,
+                stop_event=stop_event,
             )
             consecutive_failures = 0
             n_done += 1

@@ -1,0 +1,55 @@
+"""hyperopt_tpu.service — the multi-study optimization service.
+
+One long-lived server process owns the TPU and multiplexes many
+concurrent studies onto it through a continuous-batching scheduler:
+concurrent ``suggest`` requests are coalesced within a short window and
+dispatched as ONE fused device program
+(``tpe_device.multi_study_suggest_async``), with per-study durable
+state (FileTrials), admission-control backpressure (HTTP 429), and
+graceful drain.  See ``docs/service.md`` for the API and the batching /
+determinism contracts.
+
+Quick start::
+
+    # server (one per host/pod; owns the device)
+    python -m hyperopt_tpu.service --root /srv/hyperopt --port 8777
+
+    # client
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.service import ServiceClient
+    client = ServiceClient("http://127.0.0.1:8777")
+    client.minimize("my-study", objective,
+                    {"x": hp.uniform("x", -5, 5)}, max_evals=100)
+"""
+
+from .client import ServiceClient, ServiceClientError
+from .core import (
+    BackpressureError,
+    OptimizationService,
+    ServiceDraining,
+    Study,
+    StudyExists,
+    StudyNotFound,
+    StudyRegistry,
+    SuggestScheduler,
+    decode_space,
+    encode_space,
+)
+from .server import ServiceServer, free_port
+
+__all__ = [
+    "BackpressureError",
+    "OptimizationService",
+    "ServiceClient",
+    "ServiceClientError",
+    "ServiceDraining",
+    "ServiceServer",
+    "Study",
+    "StudyExists",
+    "StudyNotFound",
+    "StudyRegistry",
+    "SuggestScheduler",
+    "decode_space",
+    "encode_space",
+    "free_port",
+]

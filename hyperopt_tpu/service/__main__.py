@@ -1,0 +1,119 @@
+"""CLI: ``python -m hyperopt_tpu.service`` — run the optimization server.
+
+Serves until SIGTERM/SIGINT, then drains gracefully: new suggests are
+rejected with 503, admitted ones complete, study state is already
+write-through on disk, and the process exits 0.  Re-running with the
+same ``--root`` recovers every study.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+from .core import (
+    DEFAULT_BATCH_WINDOW,
+    DEFAULT_MAX_BATCH,
+    DEFAULT_MAX_QUEUE,
+    DEFAULT_MAX_STUDIES,
+    OptimizationService,
+)
+from .server import ServiceServer
+
+logger = logging.getLogger("hyperopt_tpu.service")
+
+
+def make_parser():
+    p = argparse.ArgumentParser(
+        prog="python -m hyperopt_tpu.service",
+        description="Multi-study TPE suggest server with continuous "
+                    "cross-study device batching.",
+    )
+    p.add_argument(
+        "--root", default=None,
+        help="service root directory for durable study state "
+             "(omit for an ephemeral in-memory server)",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument(
+        "--unsafe-allow-remote", action="store_true",
+        dest="unsafe_allow_remote",
+        help="permit binding a non-loopback host.  DANGEROUS: the API "
+             "deserializes client-supplied pickled spaces (arbitrary "
+             "code execution) and has no auth — the trust model is "
+             "cooperating clients on the same host/pod.  Front it with "
+             "an authenticating proxy before exposing it",
+    )
+    p.add_argument("--port", type=int, default=8777,
+                   help="TCP port (0 binds an ephemeral port)")
+    p.add_argument(
+        "--batch-window", type=float, default=DEFAULT_BATCH_WINDOW,
+        dest="batch_window",
+        help="seconds a batch stays open for more suggests to coalesce",
+    )
+    p.add_argument("--max-batch", type=int, default=DEFAULT_MAX_BATCH,
+                   dest="max_batch")
+    p.add_argument(
+        "--max-queue", type=int, default=DEFAULT_MAX_QUEUE,
+        dest="max_queue",
+        help="queued-suggest admission limit; beyond it requests get 429",
+    )
+    p.add_argument("--max-studies", type=int, default=DEFAULT_MAX_STUDIES,
+                   dest="max_studies")
+    p.add_argument("--log-level", default="INFO", dest="log_level")
+    return p
+
+
+def main(argv=None):
+    options = make_parser().parse_args(argv)
+    logging.basicConfig(level=getattr(
+        logging, options.log_level.upper(), logging.INFO))
+    if (
+        options.host not in ("127.0.0.1", "::1", "localhost")
+        and not options.unsafe_allow_remote
+    ):
+        logger.error(
+            "refusing to bind non-loopback host %r: the service "
+            "deserializes client-supplied pickles and has no auth "
+            "(pass --unsafe-allow-remote to override)", options.host,
+        )
+        return 2
+    service = OptimizationService(
+        root=options.root,
+        batch_window=options.batch_window,
+        max_batch=options.max_batch,
+        max_queue=options.max_queue,
+        max_studies=options.max_studies,
+    )
+    server = ServiceServer(service, host=options.host, port=options.port)
+    logger.info(
+        "optimization service listening on %s (root=%s, window=%.1fms, "
+        "max_batch=%d, max_queue=%d)",
+        server.url, options.root, options.batch_window * 1e3,
+        options.max_batch, options.max_queue,
+    )
+    print(server.url, flush=True)  # machine-readable for wrappers
+
+    def _graceful(signum, frame):
+        logger.info("signal %s: draining and shutting down", signum)
+        # off the signal handler's frame: stop() joins threads
+        threading.Thread(target=server.stop, daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _graceful)
+        signal.signal(signal.SIGINT, _graceful)
+    except ValueError:  # not on the main thread (embedded use)
+        pass
+
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

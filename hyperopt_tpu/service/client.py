@@ -1,0 +1,177 @@
+"""ServiceClient — the library side of the optimization service.
+
+Wraps the localhost HTTP API in typed calls, honors the service's
+backpressure contract (429/503 + ``Retry-After`` are retried with the
+server-suggested wait, bounded by ``retry_timeout``), and offers a
+``minimize`` convenience loop that drives suggest → evaluate → report —
+the client-side analog of ``fmin``.
+
+Stdlib only (``urllib``), one connection per call: correctness over
+micro-latency, and the server's ThreadingHTTPServer handles it fine at
+service scale.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from ..base import STATUS_FAIL, STATUS_OK
+from .core import BackpressureError, encode_space
+
+logger = logging.getLogger(__name__)
+
+
+def _quote(study_id) -> str:
+    """Path-encode a study id.  Valid ids ([A-Za-z0-9._-]) pass through
+    unchanged; anything else is escaped so a malformed id produces a
+    clean 404/400 from the server instead of a mis-parsed URL."""
+    return urllib.parse.quote(str(study_id), safe="")
+
+
+class ServiceClientError(Exception):
+    """A non-retryable error response from the service."""
+
+    def __init__(self, status, error, detail):
+        super().__init__(f"{status}: {error}: {detail}")
+        self.status = status
+        self.error = error
+        self.detail = detail
+
+
+class ServiceClient:
+    def __init__(self, base_url, timeout=180.0, retry_timeout=30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = float(timeout)
+        # total wall-clock budget for retrying 429/503 rejections before
+        # surfacing BackpressureError to the caller; 0 disables retries
+        self.retry_timeout = float(retry_timeout)
+
+    # -- transport -----------------------------------------------------
+    def _request(self, method, path, body=None):
+        deadline = time.monotonic() + self.retry_timeout
+        while True:
+            data = None
+            headers = {}
+            if body is not None:
+                data = json.dumps(body).encode()
+                headers["Content-Type"] = "application/json"
+            req = urllib.request.Request(
+                self.base_url + path, data=data, headers=headers,
+                method=method,
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    ctype = r.headers.get("Content-Type", "")
+                    raw = r.read()
+                    if ctype.startswith("application/json"):
+                        return json.loads(raw.decode())
+                    return raw.decode()
+            except urllib.error.HTTPError as e:
+                raw = e.read()
+                try:
+                    payload = json.loads(raw.decode())
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    payload = {"error": "HTTPError", "detail": raw.decode(
+                        "utf-8", "replace")}
+                if e.code in (429, 503):
+                    retry_after = float(
+                        e.headers.get("Retry-After") or 0.05
+                    )
+                    if time.monotonic() + retry_after < deadline:
+                        time.sleep(retry_after)
+                        continue
+                    raise BackpressureError(
+                        f"{e.code} from {path}: {payload.get('detail')}"
+                    )
+                raise ServiceClientError(
+                    e.code, payload.get("error"), payload.get("detail")
+                )
+
+    # -- API -----------------------------------------------------------
+    def healthz(self) -> bool:
+        return bool(self._request("GET", "/healthz").get("ok"))
+
+    def create_study(self, study_id, space, seed=0, algo="tpe",
+                     algo_params=None, exist_ok=False) -> dict:
+        return self._request("POST", "/v1/studies", {
+            "study_id": study_id,
+            "space_b64": encode_space(space),
+            "seed": int(seed),
+            "algo": algo,
+            "algo_params": algo_params or {},
+            "exist_ok": bool(exist_ok),
+        })
+
+    def suggest(self, study_id, n=1) -> list:
+        """[{"tid": int, "vals": {label: value}}, ...]"""
+        out = self._request(
+            "POST", f"/v1/studies/{_quote(study_id)}/suggest", {"n": int(n)}
+        )
+        return out["trials"]
+
+    def report(self, study_id, tid, loss=None, status=STATUS_OK,
+               result=None) -> dict:
+        body = {"tid": int(tid), "status": status}
+        if loss is not None:
+            body["loss"] = float(loss)
+        if result is not None:
+            body["result"] = result
+        return self._request(
+            "POST", f"/v1/studies/{_quote(study_id)}/report", body
+        )
+
+    def study_status(self, study_id) -> dict:
+        return self._request("GET", f"/v1/studies/{_quote(study_id)}")
+
+    def list_studies(self) -> list:
+        return self._request("GET", "/v1/studies")["studies"]
+
+    def service_status(self) -> dict:
+        return self._request("GET", "/v1/status")
+
+    def metrics(self) -> str:
+        return self._request("GET", "/metrics")
+
+    def shutdown(self) -> dict:
+        return self._request("POST", "/v1/shutdown", {})
+
+    # -- convenience loop ----------------------------------------------
+    def minimize(self, study_id, fn, space, max_evals, seed=0,
+                 algo="tpe", algo_params=None, exist_ok=True):
+        """Client-side fmin: create (or attach to) the study and drive
+        suggest → evaluate → report serially for ``max_evals`` trials.
+        ``fn`` receives the ``space_eval``-materialized point.  Returns
+        the study's final status document (``best`` holds the argmin).
+
+        A study with prior completed trials counts them toward
+        ``max_evals`` — re-running after an interruption (or a server
+        restart) continues instead of restarting.
+        """
+        from ..fmin import space_eval
+
+        status = self.create_study(
+            study_id, space, seed=seed, algo=algo,
+            algo_params=algo_params, exist_ok=exist_ok,
+        )
+        n_done = int(status.get("n_completed", 0))
+        for _ in range(max(0, int(max_evals) - n_done)):
+            (trial,) = self.suggest(study_id, n=1)
+            point = space_eval(space, trial["vals"])
+            try:
+                loss = fn(point)
+            except Exception as e:
+                logger.warning(
+                    "objective failed for trial %s: %s", trial["tid"], e
+                )
+                self.report(study_id, trial["tid"], status=STATUS_FAIL)
+                continue
+            if isinstance(loss, dict):
+                self.report(study_id, trial["tid"], result=loss)
+            else:
+                self.report(study_id, trial["tid"], loss=float(loss))
+        return self.study_status(study_id)

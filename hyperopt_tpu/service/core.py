@@ -1,0 +1,922 @@
+"""Optimization-service core: studies, registry, continuous batching.
+
+Bergstra et al.'s ICML 2013 systems paper frames hyperopt as a
+distributed asynchronous *service* around the expression-graph DSL; the
+reference realizes it as one MongoDB deployment per experiment and one
+``fmin`` process per study.  This module is the TPU-native service
+plane: ONE long-lived process owns the device and multiplexes MANY
+concurrent studies onto it.
+
+The core is a **continuous-batching scheduler** (the same shape modern
+LLM inference servers use for requests): concurrent ``suggest`` calls
+from different studies land in a bounded queue, a scheduler thread
+coalesces whatever has arrived within a short batching window, each
+study's suggest is *prepared* (``tpe.suggest_prepare`` — the fused
+device request list, built but not dispatched), and ALL prepared
+studies launch as ONE fused device program
+(``tpe_device.multi_study_suggest_async``) with one flat readback.
+While that program runs, new arrivals accumulate for the next batch —
+occupancy rises under load with no extra latency when idle.
+
+Guarantees:
+
+- **Determinism** — each study draws exactly one seed per suggest from
+  its own ``np.random.default_rng(study_seed)``, in arrival order: a
+  single-study client driven serially through the server reproduces
+  the serial ``fmin(tpe.suggest)`` trajectory trial-for-trial, because
+  batching only changes *which device program* carries the suggest,
+  never its inputs (each family core reads only its own study's
+  buffers).
+- **Durability** — with a service root, every study persists through
+  :class:`~hyperopt_tpu.parallel.file_trials.FileTrials` (write-through
+  on report; suggested docs land on disk at insert) plus a config
+  attachment and a seed cursor, so a restarted server recovers every
+  study mid-trajectory.
+- **Backpressure** — a full scheduler queue (or a full study registry)
+  rejects with :class:`BackpressureError`, which the HTTP layer maps to
+  a retryable 429; requests are never silently dropped and never hang
+  unbounded (suggest waits carry a timeout).
+- **Fault tolerance** — every fused dispatch (including the history
+  uploads inside prepare) runs under the run-shared
+  :class:`~hyperopt_tpu.resilience.device.DeviceRecovery`; seeds and
+  trial ids are drawn once per request and reused across recovery
+  retries, so recovered batches are seed-transparent.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import os
+import pickle
+import threading
+import time
+from collections import deque
+from functools import partial
+
+import numpy as np
+
+from ..base import (
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    JOB_STATE_RUNNING,
+    STATUS_FAIL,
+    STATUS_OK,
+    Domain,
+    Trials,
+)
+from ..observability import FaultStats, PhaseTimings, ServiceStats
+from ..utils import coarse_utcnow
+
+logger = logging.getLogger(__name__)
+
+# durable per-study metadata, stored as queue attachments (the blob
+# store FileTrials already provides); values are JSON bytes
+STUDY_CONFIG_ATTACHMENT = "ServiceStudyConfig"
+SEED_CURSOR_ATTACHMENT = "ServiceSeedCursor"
+
+DEFAULT_BATCH_WINDOW = 0.004
+DEFAULT_MAX_BATCH = 32
+DEFAULT_MAX_QUEUE = 64
+DEFAULT_MAX_STUDIES = 256
+DEFAULT_SUGGEST_TIMEOUT = 120.0
+
+_ALGOS = ("tpe", "rand", "anneal")
+
+
+class ServiceError(Exception):
+    """Base class for service-plane errors (each maps to an HTTP status)."""
+
+
+class BackpressureError(ServiceError):
+    """The service is over-admitted — retry after a short wait.
+
+    Raised when the scheduler queue or the study registry is full; the
+    HTTP layer maps it to ``429 Too Many Requests`` with a
+    ``Retry-After`` hint.  Never a sign of lost state: the rejected
+    request had no side effects.
+    """
+
+    retry_after = 0.05
+
+
+class ServiceDraining(ServiceError):
+    """The service is shutting down and not admitting new work (503)."""
+
+    retry_after = 1.0
+
+
+class StudyNotFound(ServiceError):
+    """No such study (404)."""
+
+
+class StudyExists(ServiceError):
+    """create_study collision without ``exist_ok`` (409)."""
+
+
+def _null_objective(config):
+    """The service never evaluates objectives — clients do.  This
+    placeholder satisfies Domain's constructor; calling it is a bug."""
+    raise RuntimeError(
+        "the optimization service does not evaluate objectives; "
+        "evaluate client-side and POST the loss to /report"
+    )
+
+
+def encode_space(space) -> str:
+    """base64(pickle(space)) — the wire form of a search space.
+
+    Pickle is the same trust model FileTrials already uses for the
+    ``FMinIter_Domain`` attachment: the service binds to localhost and
+    serves cooperating clients on the same host/pod.
+    """
+    return base64.b64encode(pickle.dumps(space)).decode("ascii")
+
+
+def decode_space(blob: str):
+    return pickle.loads(base64.b64decode(blob.encode("ascii")))
+
+
+_STUDY_ID_RE = None
+
+
+def validate_study_id(study_id) -> str:
+    """Path- and URL-safe study id or ValueError — enforced for EVERY
+    study (in-memory ones too: ids travel in URL paths and become
+    directory names the moment a durable root is configured)."""
+    global _STUDY_ID_RE
+    if _STUDY_ID_RE is None:
+        import re
+
+        # \Z, not $: '$' also matches before a trailing newline, which
+        # would admit an id that is a valid directory name but an
+        # unreachable URL path segment
+        _STUDY_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,127}\Z")
+    sid = str(study_id)
+    if not _STUDY_ID_RE.match(sid):
+        raise ValueError(
+            f"invalid study_id {study_id!r}: use 1-128 chars of "
+            f"[A-Za-z0-9._-], starting alphanumeric"
+        )
+    return sid
+
+
+def _resolve_algo(algo_name: str, algo_params: dict):
+    """(suggest_callable, prepare_callable_or_None) for a named algo.
+
+    ``algo_params`` keys are validated against the suggest signature at
+    STUDY CREATION — a typo'd keyword must fail the create with a 400,
+    not every later suggest in whatever batch it lands in."""
+    if algo_name not in _ALGOS:
+        raise ValueError(
+            f"unknown algo {algo_name!r}; expected one of {_ALGOS}"
+        )
+    if algo_name == "tpe":
+        from ..algos import tpe as mod
+    elif algo_name == "anneal":
+        from ..algos import anneal as mod
+    else:
+        from ..algos import rand as mod
+    fn = mod.suggest
+    if algo_params:
+        import inspect
+
+        accepted = set(inspect.signature(fn).parameters) - {
+            "new_ids", "domain", "trials", "seed",  # driver-owned
+        }
+        unknown = set(algo_params) - accepted
+        if unknown:
+            raise ValueError(
+                f"unknown algo_params for {algo_name!r}: "
+                f"{sorted(unknown)} (accepted: {sorted(accepted)})"
+            )
+    algo = partial(fn, **algo_params) if algo_params else fn
+    prep = getattr(fn, "prepare_variant", None)
+    if prep is not None and algo_params:
+        prep = partial(prep, **algo_params)
+    return algo, prep
+
+
+class Study:
+    """One tenant of the optimization service.
+
+    Owns the search space, the Trials store (durable FileTrials under a
+    service root, in-memory Trials otherwise), the per-study RNG, and a
+    lock serializing every read/write of that state.  The scheduler and
+    the report path both acquire ``self.lock`` — per-study mutual
+    exclusion is the whole concurrency story at this layer (cross-study
+    concurrency is the scheduler's job).
+    """
+
+    def __init__(self, study_id, space, seed, algo_name="tpe",
+                 algo_params=None, trials=None):
+        self.study_id = validate_study_id(study_id)
+        self.space = space
+        self.seed = int(seed)
+        self.algo_name = str(algo_name)
+        self.algo_params = dict(algo_params or {})
+        self.algo, self._prepare = _resolve_algo(
+            self.algo_name, self.algo_params
+        )
+        self.domain = Domain(_null_objective, space)
+        self.trials = trials if trials is not None else Trials()
+        self.lock = threading.Lock()
+        self.rstate = np.random.default_rng(self.seed)
+        self.n_seeds_drawn = 0
+        # highest DRAW POSITION whose suggest's docs have landed — the
+        # durable cursor.  A position (not a commit count): a failed
+        # suggest consumes its draw without committing, and a later
+        # committed draw must still advance the cursor PAST the failed
+        # one, or a restart would re-issue a seed an existing trial
+        # already used
+        self.n_seeds_committed = 0
+        self.created_at = time.time()
+        self._docs_by_tid = {}
+        for doc in self.trials._dynamic_trials:
+            self._docs_by_tid[int(doc["tid"])] = doc
+
+    # -- durability ----------------------------------------------------
+    @property
+    def durable(self) -> bool:
+        return getattr(self.trials, "jobs", None) is not None
+
+    def config_blob(self) -> bytes:
+        return json.dumps({
+            "study_id": self.study_id,
+            "seed": self.seed,
+            "algo_name": self.algo_name,
+            "algo_params": self.algo_params,
+            "space_b64": encode_space(self.space),
+        }, sort_keys=True).encode()
+
+    def persist_config(self):
+        if self.durable:
+            self.trials.attachments[STUDY_CONFIG_ATTACHMENT] = (
+                self.config_blob()
+            )
+
+    def config_matches(self, space, seed, algo_name, algo_params) -> bool:
+        """Is the submitted config the one this study runs?  Guards the
+        ``exist_ok`` attach path: silently serving suggestions from an
+        OLD space to a client that re-created the study with a new one
+        would crash (or corrupt) the client's space_eval."""
+        if (
+            int(seed) != self.seed
+            or str(algo_name) != self.algo_name
+            or dict(algo_params or {}) != self.algo_params
+        ):
+            return False
+        try:
+            return encode_space(space) == encode_space(self.space)
+        except Exception:
+            return False
+
+    def _persist_seed_cursor(self):
+        if self.durable:
+            self.trials.attachments[SEED_CURSOR_ATTACHMENT] = (
+                str(self.n_seeds_committed).encode()
+            )
+
+    def fast_forward_seeds(self, n: int):
+        """Re-draw ``n`` seeds after a restart so the (n+1)-th suggest
+        of the recovered study gets exactly the seed it would have
+        gotten without the restart."""
+        for _ in range(int(n)):
+            self.rstate.integers(2 ** 31 - 1)
+        self.n_seeds_drawn = int(n)
+        self.n_seeds_committed = int(n)
+
+    # -- suggest plumbing (all called under self.lock) ------------------
+    def draw_seed(self) -> int:
+        """One seed per suggest request, in arrival order — the serial
+        driver's exact protocol (FMinIter.run).  The durable cursor is
+        persisted at INSERT time, not here: a crash between draw and
+        insert must recover to "seed never consumed" (the client never
+        got a response; its retry should get this seed again — the
+        fmin-trajectory position), not to a skipped seed."""
+        seed = int(self.rstate.integers(2 ** 31 - 1))
+        self.n_seeds_drawn += 1
+        return seed
+
+    def prepare(self, new_ids, seed):
+        """(requests, finish) for the batched device plane, or None when
+        this suggest is host-side (startup/random, or an algo without a
+        prepare variant)."""
+        if self._prepare is None:
+            return None
+        return self._prepare(new_ids, self.domain, self.trials, seed)
+
+    def suggest_inline(self, new_ids, seed):
+        return self.algo(new_ids, self.domain, self.trials, seed)
+
+    def refresh_local(self):
+        """Recompute derived Trials views from the in-memory docs.  The
+        service is the queue's single writer, so its in-memory docs are
+        authoritative and the O(N)-file FileTrials.refresh disk re-read
+        is pure waste on the hot path."""
+        if self.durable:
+            self.trials.refresh_local()
+        else:
+            self.trials.refresh()
+
+    def insert(self, docs, draw_index=None):
+        self.trials.insert_trial_docs(docs)
+        # insert SONifies (copies) the docs — index the STORED copies,
+        # or report would mutate orphans the history never sees
+        for doc in self.trials._dynamic_trials[-len(docs):]:
+            self._docs_by_tid[int(doc["tid"])] = doc
+        self.refresh_local()
+        if draw_index is not None:
+            # seed-cursor commit point: this suggest's docs are now
+            # durable, so a restart fast-forwards past its draw
+            # position (see draw_seed for why not at draw time)
+            self.n_seeds_committed = max(
+                self.n_seeds_committed, int(draw_index)
+            )
+            self._persist_seed_cursor()
+
+    def report(self, tid, loss=None, status=STATUS_OK, result=None):
+        """Land one trial's outcome: DONE with a result (or ERROR for a
+        failed evaluation), written through to the durable store."""
+        doc = self._docs_by_tid.get(int(tid))
+        if doc is None:
+            raise StudyNotFound(
+                f"study {self.study_id!r} has no trial {tid}"
+            )
+        if result is None:
+            result = {"status": status}
+            if loss is not None:
+                result["loss"] = float(loss)
+        if result.get("loss") is not None and not np.isfinite(
+            float(result["loss"])
+        ):
+            # NaN/inf losses would poison best-trial math and render
+            # as invalid JSON (bare NaN) in status payloads — a
+            # diverged trial is a FAILED trial at this API
+            raise ValueError(
+                f"non-finite loss {result['loss']!r} for trial {tid}; "
+                f"report status='fail' instead"
+            )
+        doc["result"] = result
+        doc["state"] = (
+            JOB_STATE_ERROR if result.get("status") == STATUS_FAIL
+            else JOB_STATE_DONE
+        )
+        doc["refresh_time"] = coarse_utcnow()
+        if self.durable:
+            self.trials.jobs.write(doc)
+        self.refresh_local()
+        return doc
+
+    def status(self) -> dict:
+        counts = {
+            JOB_STATE_NEW: 0, JOB_STATE_RUNNING: 0,
+            JOB_STATE_DONE: 0, JOB_STATE_ERROR: 0,
+        }
+        for doc in self.trials._dynamic_trials:
+            counts[doc["state"]] = counts.get(doc["state"], 0) + 1
+        hist = self.trials.history
+        best = None
+        usable = np.flatnonzero(~np.isnan(hist.losses))
+        if len(usable):  # NaN-guard mirrors Trials.best_trial
+            i = int(usable[np.argmin(hist.losses[usable])])
+            best = {
+                "tid": int(hist.loss_tids[i]),
+                "loss": float(hist.losses[i]),
+            }
+        return {
+            "study_id": self.study_id,
+            "seed": self.seed,
+            "algo": self.algo_name,
+            "algo_params": self.algo_params,
+            "n_trials": len(self.trials._dynamic_trials),
+            "states": {str(k): v for k, v in counts.items()},
+            "n_completed": counts[JOB_STATE_DONE],
+            "n_suggests": self.n_seeds_drawn,
+            "best": best,
+            "durable": self.durable,
+        }
+
+
+class StudyRegistry:
+    """The service's study table, durable under ``root`` when set.
+
+    ``root`` layout::
+
+        <root>/studies/<study_id>/   one FileTrials queue dir per study
+                                     (trials/, locks/, attachments/ ...)
+
+    On construction every existing study directory is recovered: the
+    config attachment rebuilds the Study (space, algo, seed), FileTrials
+    re-reads the trial docs, and the seed cursor fast-forwards the RNG —
+    the study continues exactly where the previous server left it.
+    """
+
+    # lock-order: _create_lock < _studies_lock
+    def __init__(self, root=None, max_studies=DEFAULT_MAX_STUDIES):
+        self.root = os.path.abspath(root) if root else None
+        self.max_studies = int(max_studies)
+        self._studies_lock = threading.Lock()
+        # serializes whole create() calls: the capacity/exists check,
+        # the on-disk side effects (study dir + config attachment), and
+        # the registry insert must be one atomic step, or a raced
+        # duplicate create could persist the LOSER's config and break
+        # restart recovery
+        self._create_lock = threading.Lock()
+        self._studies = {}  # guarded-by: _studies_lock
+        if self.root:
+            os.makedirs(os.path.join(self.root, "studies"), exist_ok=True)
+            self._recover()
+
+    def _study_dir(self, study_id):
+        return os.path.join(
+            self.root, "studies", validate_study_id(study_id)
+        )
+
+    def _recover(self):
+        from ..parallel.file_trials import FileTrials
+
+        studies_dir = os.path.join(self.root, "studies")
+        for name in sorted(os.listdir(studies_dir)):
+            qdir = os.path.join(studies_dir, name)
+            if not os.path.isdir(qdir):
+                continue
+            try:
+                trials = FileTrials(qdir)
+                blob = trials.attachments[STUDY_CONFIG_ATTACHMENT]
+                cfg = json.loads(blob.decode())
+                study = Study(
+                    cfg["study_id"],
+                    decode_space(cfg["space_b64"]),
+                    cfg["seed"],
+                    algo_name=cfg["algo_name"],
+                    algo_params=cfg.get("algo_params") or {},
+                    trials=trials,
+                )
+                try:
+                    cursor = int(
+                        trials.attachments[SEED_CURSOR_ATTACHMENT].decode()
+                    )
+                except (KeyError, ValueError):
+                    cursor = 0
+                study.fast_forward_seeds(cursor)
+            except Exception:
+                logger.exception("could not recover study dir %s", qdir)
+                continue
+            with self._studies_lock:
+                self._studies[study.study_id] = study
+            logger.info(
+                "recovered study %r (%d trials, %d suggests served)",
+                study.study_id, len(study.trials._dynamic_trials),
+                study.n_seeds_drawn,
+            )
+
+    def create(self, study_id, space, seed=0, algo_name="tpe",
+               algo_params=None, exist_ok=False) -> Study:
+        study_id = validate_study_id(study_id)
+        # _create_lock spans check → disk side effects → insert, so a
+        # raced duplicate can never persist its config over the winner's
+        # and the capacity check cannot be overshot
+        with self._create_lock:
+            with self._studies_lock:
+                existing = self._studies.get(study_id)
+                n_now = len(self._studies)
+            if existing is not None:
+                if exist_ok:
+                    if not existing.config_matches(
+                        space, seed, algo_name, algo_params
+                    ):
+                        raise StudyExists(
+                            f"study {study_id!r} exists with a DIFFERENT "
+                            f"config (space/seed/algo); pick a new "
+                            f"study_id or delete the old study"
+                        )
+                    return existing
+                raise StudyExists(f"study {study_id!r} already exists")
+            if n_now >= self.max_studies:
+                raise BackpressureError(
+                    f"study registry full ({self.max_studies}); retry "
+                    f"after capacity frees up"
+                )
+            # validate EVERYTHING that can reject the create BEFORE any
+            # disk side effect — a rejected create must not leave an
+            # orphan study dir (no config attachment) for _recover() to
+            # trip over on every restart.  Domain construction is the
+            # space's real gate (compiles it, catches duplicate labels
+            # etc.); the throwaway instance is cheap next to a create.
+            _resolve_algo(str(algo_name), dict(algo_params or {}))
+            Domain(_null_objective, space)
+            trials = None
+            if self.root:
+                from ..parallel.file_trials import FileTrials
+
+                trials = FileTrials(self._study_dir(study_id))
+            study = Study(
+                study_id, space, seed,
+                algo_name=algo_name, algo_params=algo_params,
+                trials=trials,
+            )
+            study.persist_config()
+            with self._studies_lock:
+                self._studies[study.study_id] = study
+        return study
+
+    def get(self, study_id) -> Study:
+        with self._studies_lock:
+            study = self._studies.get(str(study_id))
+        if study is None:
+            raise StudyNotFound(f"no study {study_id!r}")
+        return study
+
+    def list(self):
+        with self._studies_lock:
+            return sorted(self._studies)
+
+    def __len__(self):
+        with self._studies_lock:
+            return len(self._studies)
+
+
+class _PendingSuggest:
+    """One queued suggest request: the handler thread waits on ``done_event``
+    while the scheduler fills ``docs`` (or ``error``).  ``ids``/``seed``
+    are drawn once on the first dispatch attempt and reused by recovery
+    retries — seed transparency across device failures."""
+
+    __slots__ = (
+        "study", "n", "ids", "seed", "draw_index", "docs", "error", "done",
+        "done_event", "cancelled", "enqueued_at",
+    )
+
+    def __init__(self, study: Study, n: int):
+        self.study = study
+        self.n = int(n)
+        self.ids = None
+        self.seed = None
+        self.draw_index = None
+        self.docs = None
+        self.error = None
+        self.done = False
+        self.cancelled = False
+        self.done_event = threading.Event()
+        self.enqueued_at = time.monotonic()
+
+    def complete(self, docs):
+        self.docs = docs
+        self.done = True
+        self.done_event.set()
+
+    def fail(self, error):
+        self.error = error
+        self.done = True
+        self.done_event.set()
+
+    def wait(self, timeout):
+        if not self.done_event.wait(timeout):
+            # best-effort cancellation: a request that has not started
+            # (no seed drawn, no ids allocated) is abandoned outright,
+            # so the client's retry gets THIS seed — no trajectory
+            # divergence and no orphan trial docs.  One already in
+            # flight completes normally (its docs land; only the
+            # response is lost), which is the unavoidable case.
+            self.cancelled = True
+            raise TimeoutError(
+                f"suggest for study {self.study.study_id!r} did not "
+                f"complete within {timeout}s"
+            )
+        if self.error is not None:
+            raise self.error
+        return self.docs
+
+
+class SuggestScheduler:
+    """The continuous-batching dispatcher.
+
+    One daemon thread: pop whatever is queued, hold the batch open for
+    ``batch_window`` seconds (or until ``max_batch``), prepare every
+    request under its study's lock, fuse ALL device-plane requests into
+    one program, resolve the single readback, finish and insert each
+    study's docs.  Host-side suggests (random startup) complete inline
+    without a device dispatch.
+
+    While a fused program runs on device, new arrivals pile into the
+    queue — the next batch picks them all up at once, which is where
+    occupancy > 1 comes from under load without adding idle latency.
+    """
+
+    def __init__(self, stats: ServiceStats = None, device_recovery=None,
+                 batch_window=DEFAULT_BATCH_WINDOW,
+                 max_batch=DEFAULT_MAX_BATCH, max_queue=DEFAULT_MAX_QUEUE):
+        self.batch_window = float(batch_window)
+        self.max_batch = int(max_batch)
+        self.max_queue = int(max_queue)
+        self.stats = stats if stats is not None else ServiceStats()
+        self.device_recovery = device_recovery
+        self._queue_cv = threading.Condition()
+        self._queue = deque()  # guarded-by: _queue_cv
+        self._draining = False  # guarded-by: _queue_cv
+        self._stopped = False  # guarded-by: _queue_cv
+        self._busy = False  # guarded-by: _queue_cv
+        self._thread = threading.Thread(
+            target=self._loop, name="hyperopt-service-scheduler", daemon=True
+        )
+        self._thread.start()
+
+    # -- submission -----------------------------------------------------
+    def submit(self, study: Study, n: int = 1) -> _PendingSuggest:
+        pending = _PendingSuggest(study, n)
+        with self._queue_cv:
+            if self._draining or self._stopped:
+                raise ServiceDraining("service is draining; not admitting")
+            if len(self._queue) >= self.max_queue:
+                self.stats.record_rejection("suggest")
+                raise BackpressureError(
+                    f"suggest queue full ({self.max_queue} waiting); "
+                    f"retry shortly"
+                )
+            self._queue.append(pending)
+            depth = len(self._queue)
+            self._queue_cv.notify_all()
+        self.stats.set_queue_depth(depth)
+        return pending
+
+    # -- scheduler thread ----------------------------------------------
+    def _loop(self):
+        while True:
+            batch = []
+            with self._queue_cv:
+                while not self._queue and not self._stopped:
+                    self._queue_cv.wait(0.1)
+                if self._stopped and not self._queue:
+                    return
+                while self._queue and len(batch) < self.max_batch:
+                    batch.append(self._queue.popleft())
+                self._busy = True
+            # batching window: only when the pop found CONCURRENT
+            # traffic does the batch stay open briefly for stragglers —
+            # a lone request (the serial-client case) dispatches
+            # immediately, so an idle server adds zero latency.  Under
+            # load, occupancy comes mostly from arrivals piling up
+            # while the previous fused program runs; the window just
+            # catches a burst's tail.
+            if len(batch) > 1:
+                deadline = time.monotonic() + self.batch_window
+                while len(batch) < self.max_batch:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    with self._queue_cv:
+                        if not self._queue:
+                            self._queue_cv.wait(remaining)
+                        while self._queue and len(batch) < self.max_batch:
+                            batch.append(self._queue.popleft())
+            with self._queue_cv:
+                depth = len(self._queue)
+            self.stats.set_queue_depth(depth)
+            try:
+                self._dispatch_batch(batch)
+            finally:
+                with self._queue_cv:
+                    self._busy = False
+                    self._queue_cv.notify_all()
+
+    def _dispatch_batch(self, batch):
+        try:
+            if self.device_recovery is not None:
+                # seeds/ids are drawn once per request (memoized on the
+                # pending), so a recovery retry re-prepares against the
+                # re-uploaded history with the SAME inputs
+                self.device_recovery.run(lambda: self._attempt(batch))
+            else:
+                self._attempt(batch)
+        except Exception as e:
+            logger.exception("suggest batch failed")
+            for p in batch:
+                if not p.done:
+                    p.fail(e)
+
+    def _attempt(self, batch):
+        from ..resilience.device import is_device_error
+
+        groups, finishes = [], []
+        for p in batch:
+            if p.done:
+                continue  # completed inline before a recovery retry
+            if p.cancelled and p.ids is None:
+                # the waiter already timed out and nothing was consumed
+                # yet: abandon it cleanly (seed stays in the study's
+                # stream for the client's retry)
+                p.fail(TimeoutError("abandoned after client timeout"))
+                continue
+            study = p.study
+            try:
+                with study.lock:
+                    if p.ids is None:
+                        p.seed = study.draw_seed()
+                        p.draw_index = study.n_seeds_drawn
+                        p.ids = study.trials.new_trial_ids(p.n)
+                    prep = study.prepare(p.ids, p.seed)
+                    if prep is None:
+                        # host-side path (random startup / no prepare
+                        # variant): complete inline, no device program
+                        docs = study.suggest_inline(p.ids, p.seed)
+                        study.insert(docs, draw_index=p.draw_index)
+            except Exception as e:
+                # multi-tenant isolation: one study's bad prepare must
+                # not fail the other studies coalesced into this batch —
+                # but device-plane errors are the whole batch's problem
+                # and must reach the recovery wrapper
+                if is_device_error(e):
+                    raise
+                logger.exception(
+                    "suggest for study %r failed", study.study_id
+                )
+                p.fail(e)
+                continue
+            if prep is None:
+                self.stats.record_inline()
+                p.complete(docs)
+            else:
+                groups.append(prep[0])
+                finishes.append((p, prep[1]))
+        if not finishes:
+            return
+        t0 = time.perf_counter()
+        from ..algos import tpe_device
+
+        resolvers = tpe_device.multi_study_suggest_async(groups)
+        outs = [r() for r in resolvers]  # ONE readback, on the first call
+        self.stats.record_dispatch(len(finishes), time.perf_counter() - t0)
+        for (p, finish), o in zip(finishes, outs):
+            study = p.study
+            try:
+                with study.lock:
+                    docs = finish(o)
+                    study.insert(docs, draw_index=p.draw_index)
+            except Exception as e:
+                if is_device_error(e):
+                    raise
+                logger.exception(
+                    "finishing suggest for study %r failed", study.study_id
+                )
+                p.fail(e)
+                continue
+            p.complete(docs)
+
+    # -- drain / shutdown ----------------------------------------------
+    def drain(self, timeout=60.0):
+        """Stop admitting, then wait for the queue and any in-flight
+        batch to finish.  Already-admitted requests all complete (or
+        fail loudly); none are dropped."""
+        deadline = time.monotonic() + timeout
+        with self._queue_cv:
+            self._draining = True
+            self._queue_cv.notify_all()
+            while self._queue or self._busy:
+                if time.monotonic() > deadline:
+                    logger.warning(
+                        "drain timed out with %d requests queued",
+                        len(self._queue),
+                    )
+                    break
+                self._queue_cv.wait(0.05)
+
+    def close(self, timeout=60.0):
+        self.drain(timeout=timeout)
+        with self._queue_cv:
+            self._stopped = True
+            self._queue_cv.notify_all()
+        self._thread.join(timeout=5.0)
+
+
+class OptimizationService:
+    """The multi-study suggest service: registry + scheduler + stats.
+
+    This is the transport-independent core — :mod:`.server` puts an HTTP
+    front on it, and tests drive it directly.  One instance per process;
+    it owns the device via the shared
+    :class:`~hyperopt_tpu.resilience.device.DeviceRecovery`.
+    """
+
+    def __init__(self, root=None, batch_window=DEFAULT_BATCH_WINDOW,
+                 max_batch=DEFAULT_MAX_BATCH, max_queue=DEFAULT_MAX_QUEUE,
+                 max_studies=DEFAULT_MAX_STUDIES,
+                 suggest_timeout=DEFAULT_SUGGEST_TIMEOUT,
+                 fault_stats=None):
+        self.stats = ServiceStats()
+        self.timings = PhaseTimings()
+        self.fault_stats = (
+            fault_stats if fault_stats is not None else FaultStats()
+        )
+        from ..resilience.device import DeviceRecovery
+
+        self.device_recovery = DeviceRecovery(stats=self.fault_stats)
+        self.registry = StudyRegistry(root, max_studies=max_studies)
+        # the gauge must reflect RECOVERED studies too, not just creates
+        self.stats.set_n_studies(len(self.registry))
+        self.scheduler = SuggestScheduler(
+            stats=self.stats,
+            device_recovery=self.device_recovery,
+            batch_window=batch_window,
+            max_batch=max_batch,
+            max_queue=max_queue,
+        )
+        self.suggest_timeout = float(suggest_timeout)
+        self.started_at = time.time()
+        self._closed = False
+
+    # -- API -----------------------------------------------------------
+    def create_study(self, study_id, space, seed=0, algo="tpe",
+                     algo_params=None, exist_ok=False) -> dict:
+        with self.timings.phase("create_study"):
+            try:
+                study = self.registry.create(
+                    study_id, space, seed=seed, algo_name=algo,
+                    algo_params=algo_params, exist_ok=exist_ok,
+                )
+            except BackpressureError:
+                # registry-full 429s must show on the same rejection
+                # counter operators watch for suggest over-admission
+                self.stats.record_rejection("create_study")
+                raise
+        self.stats.record_request("create_study")
+        self.stats.set_n_studies(len(self.registry))
+        return study.status()
+
+    def suggest(self, study_id, n=1, timeout=None) -> list:
+        """Block until the batched scheduler serves this request; returns
+        ``[{"tid": int, "vals": {label: value}}, ...]``."""
+        if n < 1:
+            raise ValueError("n must be >= 1")
+        t0 = time.perf_counter()
+        study = self.registry.get(study_id)
+        pending = self.scheduler.submit(study, n)
+        docs = pending.wait(
+            self.suggest_timeout if timeout is None else timeout
+        )
+        dt = time.perf_counter() - t0
+        self.stats.record_request("suggest", seconds=dt, study=study_id)
+        self.timings.record("suggest", dt)
+        out = []
+        for doc in docs:
+            vals = {
+                label: v[0]
+                for label, v in doc["misc"]["vals"].items()
+                if len(v)
+            }
+            out.append({"tid": int(doc["tid"]), "vals": vals})
+        return out
+
+    def report(self, study_id, tid, loss=None, status=STATUS_OK,
+               result=None) -> dict:
+        study = self.registry.get(study_id)
+        with self.timings.phase("report"):
+            with study.lock:
+                doc = study.report(
+                    tid, loss=loss, status=status, result=result
+                )
+        self.stats.record_request("report")
+        return {"tid": int(doc["tid"]), "state": doc["state"]}
+
+    def study_status(self, study_id) -> dict:
+        study = self.registry.get(study_id)
+        with study.lock:
+            out = study.status()
+        self.stats.record_request("study_status")
+        return out
+
+    def list_studies(self) -> list:
+        return self.registry.list()
+
+    def service_status(self) -> dict:
+        return {
+            "studies": len(self.registry),
+            "uptime_s": round(time.time() - self.started_at, 3),
+            "draining": self._closed,
+            "stats": self.stats.summary(),
+            "faults": self.fault_stats.summary(),
+        }
+
+    def metrics_text(self) -> str:
+        from ..observability import render_prometheus
+
+        return render_prometheus(
+            timings=self.timings,
+            faults=self.fault_stats,
+            service=self.stats,
+            extra={"service_uptime_seconds": time.time() - self.started_at},
+        )
+
+    # -- lifecycle -----------------------------------------------------
+    def drain(self, timeout=60.0):
+        """Graceful shutdown step 1: reject new suggests, finish the
+        admitted ones.  Study state is already on disk (write-through),
+        so after drain a restart recovers everything."""
+        self._closed = True
+        self.scheduler.drain(timeout=timeout)
+
+    def close(self, timeout=60.0):
+        self._closed = True
+        self.scheduler.close(timeout=timeout)

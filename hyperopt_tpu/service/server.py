@@ -1,0 +1,268 @@
+"""HTTP front-end for the optimization service (stdlib only).
+
+``ThreadingHTTPServer`` + JSON bodies over localhost — one handler
+thread per in-flight request, which is exactly what the continuous
+-batching scheduler wants: every blocked ``/suggest`` is a queued
+request the next batch can coalesce.
+
+API (all JSON unless noted)::
+
+    GET  /healthz                         liveness probe
+    GET  /metrics                         Prometheus text exposition
+    GET  /v1/status                       service-wide stats snapshot
+    GET  /v1/studies                      {"studies": [id, ...]}
+    GET  /v1/studies/<id>                 study status document
+    POST /v1/studies                      create: {"study_id", "space_b64",
+                                          "seed", "algo", "algo_params",
+                                          "exist_ok"}
+    POST /v1/studies/<id>/suggest         {"n": 1} -> {"trials": [{"tid",
+                                          "vals"}, ...]}
+    POST /v1/studies/<id>/report          {"tid", "loss", "status"} or
+                                          {"tid", "result": {...}}
+    POST /v1/shutdown                     drain + stop (localhost control)
+
+Error contract: over-admission returns **429** with a ``Retry-After``
+header (retry is always safe — a rejected request had no side effects);
+a draining server returns **503**; unknown studies **404**; create
+collisions **409**; malformed requests **400**.  Suggest waits are
+bounded by the service's ``suggest_timeout`` and surface as **504**.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..base import STATUS_OK
+from .core import (
+    BackpressureError,
+    OptimizationService,
+    ServiceDraining,
+    StudyExists,
+    StudyNotFound,
+    decode_space,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class _ServiceHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    service: OptimizationService = None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "hyperopt-tpu-service/0.1"
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ------------------------------------------------------
+    def log_message(self, fmt, *args):  # route access logs to logging
+        logger.debug("%s - %s", self.address_string(), fmt % args)
+
+    def _read_json(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        if not length:
+            return {}
+        raw = self.rfile.read(length)
+        try:
+            return json.loads(raw.decode())
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise ValueError(f"malformed JSON body: {e}")
+
+    def _send(self, code, payload, content_type="application/json",
+              headers=()):
+        body = (
+            payload if isinstance(payload, bytes)
+            else json.dumps(payload).encode()
+        )
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_error_json(self, code, exc, retry_after=None):
+        headers = ()
+        if retry_after is not None:
+            headers = (("Retry-After", f"{retry_after:.3f}"),)
+        self._send(
+            code,
+            {"error": type(exc).__name__, "detail": str(exc)},
+            headers=headers,
+        )
+
+    def _dispatch(self, handler):
+        try:
+            handler()
+        except BackpressureError as e:
+            self._send_error_json(429, e, retry_after=e.retry_after)
+        except ServiceDraining as e:
+            self._send_error_json(503, e, retry_after=e.retry_after)
+        except StudyNotFound as e:
+            self._send_error_json(404, e)
+        except StudyExists as e:
+            self._send_error_json(409, e)
+        except TimeoutError as e:
+            self._send_error_json(504, e)
+        except (ValueError, KeyError, TypeError) as e:
+            self._send_error_json(400, e)
+        except BrokenPipeError:  # client went away mid-response
+            pass
+        except Exception as e:  # pragma: no cover - defensive
+            logger.exception("unhandled service error")
+            self._send_error_json(500, e)
+
+    @property
+    def service(self) -> OptimizationService:
+        return self.server.service
+
+    # -- routes --------------------------------------------------------
+    def do_GET(self):
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+
+        def handle():
+            if path == "/healthz":
+                self._send(200, {"ok": True})
+            elif path == "/metrics":
+                self._send(
+                    200,
+                    self.service.metrics_text().encode(),
+                    content_type="text/plain; version=0.0.4",
+                )
+            elif path == "/v1/status":
+                self._send(200, self.service.service_status())
+            elif path == "/v1/studies":
+                self._send(200, {"studies": self.service.list_studies()})
+            elif path.startswith("/v1/studies/"):
+                study_id = path[len("/v1/studies/"):]
+                if "/" in study_id:
+                    raise ValueError(f"bad path {self.path!r}")
+                self._send(200, self.service.study_status(study_id))
+            else:
+                self._send(404, {"error": "NotFound", "detail": path})
+
+        self._dispatch(handle)
+
+    def do_POST(self):
+        path = self.path.split("?", 1)[0].rstrip("/")
+
+        def handle():
+            # read the body FIRST on every route: an unread body left in
+            # a keep-alive stream desyncs the next request's parse
+            body = self._read_json()
+            if path == "/v1/studies":
+                out = self.service.create_study(
+                    body["study_id"],
+                    decode_space(body["space_b64"]),
+                    seed=int(body.get("seed", 0)),
+                    algo=body.get("algo", "tpe"),
+                    algo_params=body.get("algo_params") or None,
+                    exist_ok=bool(body.get("exist_ok", False)),
+                )
+                self._send(200, out)
+            elif path.startswith("/v1/studies/") and path.endswith("/suggest"):
+                study_id = path[len("/v1/studies/"):-len("/suggest")]
+                trials = self.service.suggest(
+                    study_id, n=int(body.get("n", 1))
+                )
+                self._send(200, {"trials": trials})
+            elif path.startswith("/v1/studies/") and path.endswith("/report"):
+                study_id = path[len("/v1/studies/"):-len("/report")]
+                out = self.service.report(
+                    study_id,
+                    body["tid"],
+                    loss=body.get("loss"),
+                    status=body.get("status", STATUS_OK),
+                    result=body.get("result"),
+                )
+                self._send(200, out)
+            elif path == "/v1/shutdown":
+                self._send(200, {"ok": True, "draining": True})
+                # drain + stop off-thread: this handler must finish its
+                # response before serve_forever is told to exit
+                threading.Thread(
+                    target=self.server._begin_shutdown, daemon=True
+                ).start()
+            else:
+                self._send(404, {"error": "NotFound", "detail": path})
+
+        self._dispatch(handle)
+
+
+class ServiceServer:
+    """Owns the HTTP listener thread around an OptimizationService.
+
+    ``port=0`` binds an ephemeral port (read it back from ``.port``).
+    ``stop()`` is the graceful path: drain the scheduler (admitted
+    suggests complete; new ones get 503), then stop the listener.  All
+    study state is write-through, so a subsequent server on the same
+    root recovers every study.
+    """
+
+    def __init__(self, service: OptimizationService = None,
+                 host="127.0.0.1", port=0, **service_kwargs):
+        self.service = (
+            service if service is not None
+            else OptimizationService(**service_kwargs)
+        )
+        self.httpd = _ServiceHTTPServer((host, port), _Handler)
+        self.httpd.service = self.service
+        self.httpd._begin_shutdown = self._begin_shutdown
+        self.host, self.port = self.httpd.server_address[:2]
+        self._thread = None
+        self._stop_lock = threading.Lock()
+        self._stopped = False
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            kwargs={"poll_interval": 0.05},
+            name="hyperopt-service-http",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        """Foreground serving (the CLI path)."""
+        self.httpd.serve_forever(poll_interval=0.1)
+
+    def _begin_shutdown(self):
+        self.stop(drain=True)
+
+    def stop(self, drain=True, timeout=60.0):
+        with self._stop_lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        # close() drains internally; a zero timeout skips the wait so a
+        # wedged dispatch can't burn 2x the drain budget
+        self.service.close(timeout=timeout if drain else 0.0)
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def free_port(host="127.0.0.1"):
+    """An OS-assigned free TCP port (tests / loadgen convenience)."""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]

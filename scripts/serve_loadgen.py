@@ -1,0 +1,195 @@
+"""Seeded multi-study load generator for the optimization service.
+
+The ISSUE-4 acceptance run: ``--studies`` (default 8) concurrent
+studies, each a serial HTTP client driving suggest → simulated
+objective → report against ONE in-process server, all seeded.  Emits
+``BENCH_SERVE.json`` with the serving headlines:
+
+- ``suggest_p50_ms`` / ``suggest_p99_ms`` — end-to-end suggest latency
+  through the HTTP plane (queue wait + batching window + fused device
+  program + readback);
+- ``mean_batch_occupancy`` — suggest requests per fused device
+  dispatch (the continuous-batching win: > 1 means the device ran
+  fewer programs than the studies made requests);
+- ``n_dispatches`` vs ``n_batched_suggests`` — the dispatch-count
+  reduction itself.
+
+Acceptance gate (exit code): every study completes every trial, mean
+occupancy > 1.5, and dispatches < device-plane suggest requests.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/serve_loadgen.py \
+        [--studies 8] [--trials 20] [--seed 0] [--quick] [--out BENCH_SERVE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+# fast TPE engagement: the startup trials are host-side and don't
+# exercise the batching plane this benchmark measures
+ALGO_PARAMS = {"n_startup_jobs": 3, "n_EI_candidates": 64}
+
+
+def _space():
+    from hyperopt_tpu import hp
+
+    return {
+        "x": hp.uniform("x", -5, 5),
+        "lr": hp.loguniform("lr", -5, 0),
+        "w": hp.quniform("w", 0, 10, 1),
+        "c": hp.choice("c", ["a", "b", "d"]),
+    }
+
+
+def _objective(point, rng):
+    """Deterministic-per-draw synthetic objective (no sleep: latency
+    under CONTENTION is the point — while one fused program runs, the
+    other studies' requests pile into the next batch)."""
+    return (
+        (point["x"] - 1.0) ** 2
+        + (np.log(point["lr"]) + 2.0) ** 2
+        + 0.1 * point["w"]
+        + (0.5 if point["c"] == "b" else 0.0)
+        + float(rng.normal()) * 0.01
+    )
+
+
+def run_loadgen(n_studies=8, n_trials=20, seed=0, batch_window=0.004,
+                root=None):
+    """Run the seeded campaign; returns the BENCH_SERVE.json payload."""
+    from hyperopt_tpu.fmin import space_eval
+    from hyperopt_tpu.service import (
+        OptimizationService,
+        ServiceClient,
+        ServiceServer,
+    )
+
+    space = _space()
+    service = OptimizationService(root=root, batch_window=batch_window)
+    server = ServiceServer(service).start()
+    errors = []
+    t0 = time.perf_counter()
+    try:
+        def drive(study_idx):
+            try:
+                sid = f"load-{study_idx}"
+                client = ServiceClient(server.url)
+                client.create_study(
+                    sid, space, seed=seed * 1000 + study_idx,
+                    algo="tpe", algo_params=ALGO_PARAMS,
+                )
+                rng = np.random.default_rng(seed * 1000 + study_idx)
+                for _ in range(n_trials):
+                    (t,) = client.suggest(sid)
+                    point = space_eval(space, t["vals"])
+                    client.report(
+                        sid, t["tid"], loss=_objective(point, rng)
+                    )
+            except Exception as e:
+                errors.append(f"study {study_idx}: {e!r}")
+
+        threads = [
+            threading.Thread(target=drive, args=(i,), daemon=True)
+            for i in range(n_studies)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        alive = [t for t in threads if t.is_alive()]
+        if alive:
+            errors.append(f"{len(alive)} study clients timed out")
+        wall_s = time.perf_counter() - t0
+        stats = service.stats.summary()
+        completed = {
+            sid: service.study_status(sid)["n_completed"]
+            for sid in service.list_studies()
+        }
+    finally:
+        server.stop()
+
+    total_suggests = n_studies * n_trials
+    occ = stats["mean_batch_occupancy"]
+    ok = (
+        not errors
+        and all(v == n_trials for v in completed.values())
+        and occ is not None
+        and occ > 1.5
+        and stats["n_dispatches"] < stats["n_batched_suggests"]
+    )
+    return {
+        "metric": "serve_loadgen",
+        "ok": ok,
+        "errors": errors,
+        "n_studies": n_studies,
+        "n_trials_per_study": n_trials,
+        "seed": seed,
+        "batch_window_s": batch_window,
+        "algo_params": ALGO_PARAMS,
+        "total_suggest_requests": total_suggests,
+        "suggest_p50_ms": stats["suggest_latency"]["p50_ms"],
+        "suggest_p99_ms": stats["suggest_latency"]["p99_ms"],
+        "mean_batch_occupancy": occ,
+        "n_dispatches": stats["n_dispatches"],
+        "n_batched_suggests": stats["n_batched_suggests"],
+        "n_inline_suggests": stats["n_inline_suggests"],
+        "dispatch_s_total": stats["dispatch_s"],
+        "rejected": stats["rejected"],
+        "completed_per_study": completed,
+        "wall_s": round(wall_s, 3),
+        "suggests_per_sec": round(total_suggests / wall_s, 2),
+        "platform": _platform(),
+    }
+
+
+def _platform():
+    import jax
+
+    return jax.devices()[0].platform
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--studies", type=int, default=8)
+    ap.add_argument("--trials", type=int, default=20)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--batch-window", type=float, default=0.004,
+                    dest="batch_window")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI smoke config (8 studies x 8 trials)")
+    ap.add_argument(
+        "--out",
+        default=os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "BENCH_SERVE.json",
+        ),
+    )
+    options = ap.parse_args(argv)
+    n_trials = 8 if options.quick else options.trials
+    report = run_loadgen(
+        n_studies=options.studies,
+        n_trials=n_trials,
+        seed=options.seed,
+        batch_window=options.batch_window,
+    )
+    print(json.dumps(report, indent=1))
+    if options.out:
+        with open(options.out, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -175,3 +175,115 @@ class TestRevisionContract:
         r0 = t._revision
         t.refresh()
         assert t._revision > r0
+
+
+class TestMultiFamilyBatching:
+    """multi_family_suggest over MIXED family batches at varying batch
+    sizes, plus the program-reuse contract (ISSUE 4 satellite): one
+    trace per (_multi_sig, shape-bucket) key, verified through the
+    PR-2 RecompilationAuditor."""
+
+    MIXED_SPACE = {
+        "x": hp.uniform("x", -5, 5),          # cont, linear
+        "lr": hp.loguniform("lr", -5, 0),     # cont, log
+        "w": hp.quniform("w", 0, 10, 1),      # cont, quantized bounded
+        "c": hp.choice("c", ["a", "b", "d"]),  # idx
+    }
+
+    def _mixed_setup(self, n=8, seed=0):
+        from hyperopt_tpu.algos import rand
+
+        domain = Domain(lambda c: 0.0, self.MIXED_SPACE)
+        trials = Trials()
+        rng = np.random.default_rng(seed)
+        for i in range(n):
+            docs = rand.suggest(
+                [i], domain, trials, int(rng.integers(2 ** 31 - 1))
+            )
+            docs[0]["state"] = JOB_STATE_DONE
+            docs[0]["result"] = {
+                "status": STATUS_OK, "loss": float(rng.normal()),
+            }
+            trials.insert_trial_docs(docs)
+            trials.refresh()
+        return domain, trials
+
+    def test_mixed_families_varying_batch_sizes(self):
+        from hyperopt_tpu.algos import tpe
+
+        domain, trials = self._mixed_setup(n=8)
+        kw = dict(n_startup_jobs=4, n_EI_candidates=32)
+        next_id = 8
+        for k in (1, 3, 5):
+            ids = list(range(next_id, next_id + k))
+            next_id += k
+            docs = tpe.suggest(ids, domain, trials, 1000 + k, **kw)
+            assert len(docs) == k
+            for doc in docs:
+                vals = doc["misc"]["vals"]
+                assert set(vals) == set(self.MIXED_SPACE)
+                assert -5 <= vals["x"][0] <= 5
+                assert np.exp(-5) <= vals["lr"][0] <= np.exp(0) + 1e-9
+                assert vals["w"][0] == int(vals["w"][0])  # quantized
+                assert 0 <= vals["w"][0] <= 10
+                assert vals["c"][0] in (0, 1, 2)
+
+    def test_one_trace_per_multi_sig(self):
+        """Growing history + varying batch sizes: every fused-program
+        trace key (static signature x shape bucket) compiles exactly
+        once — re-traces of the SAME key mean a per-call value leaked
+        into the jit cache key."""
+        from hyperopt_tpu.algos import tpe
+        from hyperopt_tpu.analysis import RecompilationAuditor
+
+        domain, trials = self._mixed_setup(n=8)
+        kw = dict(n_startup_jobs=4, n_EI_candidates=32)
+        rng = np.random.default_rng(3)
+        with RecompilationAuditor() as aud:
+            next_id = 8
+            # repeat each batch size so reuse (not just counting) is
+            # exercised; history grows across power-of-two boundaries
+            for k in (1, 2, 1, 2, 1, 1, 2, 1, 2, 1):
+                ids = list(range(next_id, next_id + k))
+                next_id += k
+                docs = tpe.suggest(ids, domain, trials, next_id, **kw)
+                for doc in docs:
+                    doc["state"] = JOB_STATE_DONE
+                    doc["result"] = {
+                        "status": STATUS_OK, "loss": float(rng.normal()),
+                    }
+                trials.insert_trial_docs(docs)
+                trials.refresh()
+        assert aud.n_traces >= 2  # batch-size change + bucket growth
+        assert all(n == 1 for n in aud.trace_counts.values()), (
+            aud.trace_counts
+        )
+        assert aud.diagnostics() == []
+
+    def test_multi_study_groups_share_one_dispatch(self):
+        """multi_study_suggest_async fuses different studies' request
+        lists; per-group resolvers return exactly the per-family winner
+        arrays the unbatched dispatch returns."""
+        from hyperopt_tpu.algos import tpe
+
+        kw = dict(n_startup_jobs=4, n_EI_candidates=32)
+        da, ta = self._mixed_setup(n=8, seed=0)
+        db, tb = self._mixed_setup(n=12, seed=1)
+        prep_a = tpe.suggest_prepare([8], da, ta, 77, **kw)
+        prep_b = tpe.suggest_prepare([12, 13], db, tb, 88, **kw)
+        ref_a = [np.asarray(o) for o in
+                 tpe_device.multi_family_suggest(prep_a[0])]
+        ref_b = [np.asarray(o) for o in
+                 tpe_device.multi_family_suggest(prep_b[0])]
+        # re-prepare: the first dispatch consumed nothing, but keep the
+        # inputs visibly identical
+        prep_a = tpe.suggest_prepare([8], da, ta, 77, **kw)
+        prep_b = tpe.suggest_prepare([12, 13], db, tb, 88, **kw)
+        res_a, res_b = tpe_device.multi_study_suggest_async(
+            [prep_a[0], prep_b[0]]
+        )
+        got_a = [np.asarray(o) for o in res_a()]
+        got_b = [np.asarray(o) for o in res_b()]
+        assert len(got_a) == len(ref_a) and len(got_b) == len(ref_b)
+        for g, r in zip(got_a + got_b, ref_a + ref_b):
+            np.testing.assert_array_equal(g, r)

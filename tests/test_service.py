@@ -1,0 +1,824 @@
+"""Optimization service (hyperopt_tpu.service).
+
+Covers the ISSUE 4 contract:
+
+- determinism: a single-study client driven serially through the
+  service reproduces the serial ``fmin(tpe.suggest)`` trajectory
+  trial-for-trial, and a prepared+batched dispatch is identical to the
+  unbatched ``tpe.suggest`` for the same inputs;
+- continuous batching: concurrent studies coalesce into fused device
+  programs with mean occupancy > 1 and fewer dispatches than requests;
+- backpressure: over-admission returns a retryable rejection with no
+  side effects (never a hang, never a dropped study);
+- durability + drain: shutdown mid-study and a restarted server on the
+  same root continue the exact trajectory an uninterrupted run takes;
+- the HTTP plane end-to-end (create/suggest/report/status/metrics/
+  shutdown, error mapping) and the ``python -m hyperopt_tpu.service``
+  CLI with graceful SIGTERM;
+- the worker CLI's graceful shutdown (satellite): SIGTERM mid-trial
+  finishes the trial, releases lock+lease, exits 0;
+- ServiceStats accounting and the Prometheus text renderer.
+"""
+
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import threading
+import time
+from functools import partial
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu.algos import rand, tpe, tpe_device
+from hyperopt_tpu.base import (
+    JOB_STATE_DONE,
+    JOB_STATE_NEW,
+    STATUS_OK,
+    Domain,
+)
+from hyperopt_tpu.fmin import space_eval
+from hyperopt_tpu.observability import (
+    FaultStats,
+    PhaseTimings,
+    ServiceStats,
+    SpeculationStats,
+    render_prometheus,
+)
+from hyperopt_tpu.service import (
+    BackpressureError,
+    OptimizationService,
+    ServiceClient,
+    ServiceServer,
+    StudyExists,
+    StudyNotFound,
+    decode_space,
+    encode_space,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# mixed families: continuous, categorical (idx), bounded-quantized
+SPACE = {
+    "x": hp.uniform("x", -5, 5),
+    "c": hp.choice("c", ["a", "b"]),
+    "w": hp.quniform("w", 0, 10, 1),
+}
+AP = {"n_startup_jobs": 4, "n_EI_candidates": 32}
+
+
+def _objective(cfg):
+    return (
+        (cfg["x"] - 1.0) ** 2
+        + (0.5 if cfg["c"] == "b" else 0.0)
+        + 0.1 * cfg["w"]
+    )
+
+
+def _drive(svc, study_id, n, objective=_objective):
+    """Serial suggest→evaluate→report client loop against the core."""
+    out = []
+    for _ in range(n):
+        (t,) = svc.suggest(study_id, n=1)
+        out.append(t)
+        point = space_eval(SPACE, t["vals"])
+        svc.report(study_id, t["tid"], loss=objective(point))
+    return out
+
+
+def _serial_fmin_vals(seed, max_evals):
+    trials = Trials()
+    fmin(
+        _objective, SPACE, algo=partial(tpe.suggest, **AP),
+        max_evals=max_evals, trials=trials,
+        rstate=np.random.default_rng(seed), show_progressbar=False,
+        verbose=False, max_speculation=0,
+    )
+    return [
+        {k: v[0] for k, v in t["misc"]["vals"].items() if len(v)}
+        for t in trials.trials
+    ]
+
+
+# ---------------------------------------------------------------------
+# determinism
+# ---------------------------------------------------------------------
+
+
+class TestDeterminism:
+    def test_single_study_reproduces_serial_fmin(self):
+        ref = _serial_fmin_vals(seed=42, max_evals=12)
+        svc = OptimizationService(root=None, batch_window=0.001)
+        try:
+            svc.create_study("s", SPACE, seed=42, algo="tpe",
+                             algo_params=AP)
+            got = _drive(svc, "s", 12)
+        finally:
+            svc.close()
+        assert len(ref) == len(got) == 12
+        for i, (rv, g) in enumerate(zip(ref, got)):
+            assert rv.keys() == g["vals"].keys(), (i, rv, g)
+            for k in rv:
+                assert np.isclose(rv[k], g["vals"][k]), (i, k, rv, g)
+
+    def test_batched_dispatch_identical_to_unbatched(self):
+        """Two studies' suggests fused into ONE device program equal the
+        two unbatched tpe.suggest calls bit-for-bit — batching changes
+        the carrier program, never the result."""
+        def mk_trials(seed, n=6):
+            domain = Domain(lambda c: 0.0, SPACE)
+            trials = Trials()
+            rng = np.random.default_rng(seed)
+            for i in range(n):
+                docs = rand.suggest([i], domain, trials,
+                                    int(rng.integers(2 ** 31 - 1)))
+                docs[0]["state"] = JOB_STATE_DONE
+                docs[0]["result"] = {
+                    "status": STATUS_OK, "loss": float(rng.normal()),
+                }
+                trials.insert_trial_docs(docs)
+                trials.refresh()
+            return domain, trials
+
+        da, ta = mk_trials(0)
+        db, tb = mk_trials(1, n=9)  # different history sizes on purpose
+        kw = dict(n_startup_jobs=4, n_EI_candidates=32)
+        direct_a = tpe.suggest([6], da, ta, 123, **kw)
+        direct_b = tpe.suggest([9, 10], db, tb, 456, **kw)
+
+        prep_a = tpe.suggest_prepare([6], da, ta, 123, **kw)
+        prep_b = tpe.suggest_prepare([9, 10], db, tb, 456, **kw)
+        assert prep_a is not None and prep_b is not None
+        res_a, res_b = tpe_device.multi_study_suggest_async(
+            [prep_a[0], prep_b[0]]
+        )
+        batched_b = prep_b[1](res_b())  # resolve out of order on purpose
+        batched_a = prep_a[1](res_a())
+
+        for direct, batched in ((direct_a, batched_a),
+                                (direct_b, batched_b)):
+            assert len(direct) == len(batched)
+            for d, b in zip(direct, batched):
+                assert d["misc"]["vals"] == b["misc"]["vals"]
+
+    def test_prepare_returns_none_on_startup(self):
+        domain = Domain(lambda c: 0.0, SPACE)
+        trials = Trials()
+        assert tpe.suggest_prepare([0], domain, trials, 0) is None
+
+
+# ---------------------------------------------------------------------
+# continuous batching + backpressure
+# ---------------------------------------------------------------------
+
+
+class TestScheduler:
+    def test_concurrent_studies_batch(self):
+        svc = OptimizationService(root=None, batch_window=0.02)
+        n_studies, n_trials = 6, 7
+        try:
+            for i in range(n_studies):
+                svc.create_study(f"s{i}", SPACE, seed=i, algo="tpe",
+                                 algo_params=AP)
+            errors = []
+
+            def worker(sid):
+                try:
+                    _drive(svc, sid, n_trials)
+                except Exception as e:  # pragma: no cover - debug aid
+                    errors.append(e)
+
+            threads = [
+                threading.Thread(target=worker, args=(f"s{i}",))
+                for i in range(n_studies)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            assert not errors, errors
+            s = svc.stats.summary()
+        finally:
+            svc.close()
+        total_requests = s["requests"]["suggest"]
+        assert total_requests == n_studies * n_trials
+        # the startup suggests are host-side; the TPE ones all went
+        # through fused dispatches, and batching means strictly fewer
+        # dispatches than device-plane requests
+        assert s["n_batched_suggests"] == total_requests - s["n_inline_suggests"]
+        assert s["n_dispatches"] < s["n_batched_suggests"]
+        assert s["mean_batch_occupancy"] > 1.0
+        # every study completed every trial — nothing dropped
+        for i in range(n_studies):
+            assert svc.study_status(f"s{i}")["n_completed"] == n_trials
+
+    def test_backpressure_rejects_without_side_effects(self):
+        svc = OptimizationService(root=None, max_queue=0)
+        try:
+            svc.create_study("s", SPACE, seed=0, algo_params=AP)
+            study = svc.registry.get("s")
+            with pytest.raises(BackpressureError):
+                svc.suggest("s", n=1)
+            # no ids were allocated, no seed drawn: retry is safe
+            assert study.n_seeds_drawn == 0
+            assert len(study.trials._dynamic_trials) == 0
+            assert svc.stats.summary()["rejected"]["suggest"] == 1
+        finally:
+            svc.close()
+
+    def test_registry_full_is_backpressure(self):
+        svc = OptimizationService(root=None, max_studies=1)
+        try:
+            svc.create_study("a", SPACE)
+            with pytest.raises(BackpressureError):
+                svc.create_study("b", SPACE)
+        finally:
+            svc.close()
+
+    def test_nan_loss_rejected_at_report(self):
+        # a diverged trial is a FAILED trial at this API: NaN/inf would
+        # poison best-trial math and render as invalid JSON downstream
+        svc = OptimizationService(root=None)
+        try:
+            svc.create_study("n", SPACE, seed=0)
+            (t,) = svc.suggest("n")
+            with pytest.raises(ValueError, match="non-finite"):
+                svc.report("n", t["tid"], loss=float("nan"))
+            svc.report("n", t["tid"], status="fail")  # the sanctioned path
+            st = svc.study_status("n")
+            assert st["best"] is None
+        finally:
+            svc.close()
+
+    def test_rejected_create_leaves_no_orphan_dir(self, tmp_path):
+        root = str(tmp_path / "r")
+        svc = OptimizationService(root=root)
+        try:
+            with pytest.raises(ValueError):
+                svc.create_study("typo", SPACE, algo_params={"bogus": 1})
+            assert not os.path.exists(
+                os.path.join(root, "studies", "typo")
+            )
+        finally:
+            svc.close()
+        # and a fresh server recovers cleanly (nothing to trip over)
+        svc2 = OptimizationService(root=root)
+        try:
+            assert svc2.list_studies() == []
+        finally:
+            svc2.close()
+
+    def test_bad_space_leaves_no_orphan_dir(self, tmp_path):
+        # a space that fails Domain construction (duplicate labels
+        # assembled without hp.* validation) must reject BEFORE any
+        # disk side effect — no orphan study dir for _recover()
+        root = str(tmp_path / "r")
+        dup_space = {"a": hp.uniform("x", 0, 1), "b": hp.uniform("x", 0, 1)}
+        svc = OptimizationService(root=root)
+        try:
+            with pytest.raises(Exception):
+                svc.create_study("dup", dup_space)
+            assert not os.path.exists(os.path.join(root, "studies", "dup"))
+        finally:
+            svc.close()
+
+    def test_registry_full_counts_as_rejection(self):
+        svc = OptimizationService(root=None, max_studies=1)
+        try:
+            svc.create_study("a", SPACE)
+            with pytest.raises(BackpressureError):
+                svc.create_study("b", SPACE)
+            assert svc.stats.summary()["rejected"] == {"create_study": 1}
+        finally:
+            svc.close()
+
+    def test_bad_algo_params_rejected_at_create(self):
+        # a typo'd keyword must fail the CREATE (400), not poison every
+        # batch its suggests later land in (multi-tenant isolation)
+        svc = OptimizationService(root=None)
+        try:
+            with pytest.raises(ValueError, match="bogus"):
+                svc.create_study("b", SPACE, algo_params={"bogus": 1})
+        finally:
+            svc.close()
+
+    def test_invalid_study_id_rejected(self):
+        svc = OptimizationService(root=None)
+        try:
+            for bad in ("a/b", "a b", "", ".", "a?b", "x" * 200):
+                with pytest.raises(ValueError):
+                    svc.create_study(bad, SPACE)
+        finally:
+            svc.close()
+
+    def test_one_studys_failure_does_not_fail_batchmates(self):
+        """A per-study finish/prepare exception fails only that pending;
+        other studies coalesced into the same batch complete."""
+        svc = OptimizationService(root=None, batch_window=0.05)
+        try:
+            svc.create_study("good", SPACE, seed=0, algo_params=AP)
+            svc.create_study("sick", SPACE, seed=1, algo_params=AP)
+            # warm both past startup so both take the device path
+            for sid in ("good", "sick"):
+                _drive(svc, sid, AP["n_startup_jobs"] + 1)
+            # break the sick study's prepare only
+            sick = svc.registry.get("sick")
+            def broken_prepare(ids, seed):
+                raise RuntimeError("synthetic study-local failure")
+            sick.prepare = broken_prepare
+            results = {}
+
+            def call(sid):
+                try:
+                    results[sid] = svc.suggest(sid, timeout=60)
+                except Exception as e:
+                    results[sid] = e
+
+            threads = [threading.Thread(target=call, args=(sid,))
+                       for sid in ("good", "sick")]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert isinstance(results["sick"], RuntimeError)
+            assert isinstance(results["good"], list) and results["good"]
+        finally:
+            svc.close()
+
+    def test_study_errors(self):
+        svc = OptimizationService(root=None)
+        try:
+            with pytest.raises(StudyNotFound):
+                svc.suggest("nope")
+            svc.create_study("a", SPACE)
+            with pytest.raises(StudyExists):
+                svc.create_study("a", SPACE)
+            again = svc.create_study("a", SPACE, exist_ok=True)
+            assert again["study_id"] == "a"
+        finally:
+            svc.close()
+
+    def test_exist_ok_rejects_config_mismatch(self):
+        svc = OptimizationService(root=None)
+        try:
+            svc.create_study("a", SPACE, seed=0, algo_params=AP)
+            # same config attaches...
+            svc.create_study("a", SPACE, seed=0, algo_params=AP,
+                             exist_ok=True)
+            # ...but a different space/seed/algo is a 409, not a silent
+            # attach serving suggestions from the OLD config
+            other_space = {"x": hp.uniform("x", -1, 1)}
+            with pytest.raises(StudyExists, match="DIFFERENT"):
+                svc.create_study("a", other_space, seed=0,
+                                 algo_params=AP, exist_ok=True)
+            with pytest.raises(StudyExists, match="DIFFERENT"):
+                svc.create_study("a", SPACE, seed=1, algo_params=AP,
+                                 exist_ok=True)
+        finally:
+            svc.close()
+
+    def test_exist_ok_matches_across_http_roundtrip(self):
+        # the space crosses the wire as a pickle blob; two decodes of
+        # the same client-side space must still compare equal
+        with ServiceServer(OptimizationService(root=None)) as server:
+            c1 = ServiceClient(server.url)
+            c2 = ServiceClient(server.url)
+            c1.create_study("h", SPACE, seed=3, algo_params=AP)
+            st = c2.create_study("h", SPACE, seed=3, algo_params=AP,
+                                 exist_ok=True)
+            assert st["study_id"] == "h"
+
+    def test_failed_suggest_does_not_desync_seed_cursor(self, tmp_path):
+        """A suggest that fails after its seed draw must not shift the
+        restart fast-forward: later committed draws advance the cursor
+        PAST the failed position (a seed an existing trial used can
+        never be re-issued)."""
+        root = str(tmp_path / "r")
+        svc = OptimizationService(root=root, batch_window=0.001)
+        try:
+            svc.create_study("s", SPACE, seed=9, algo_params=AP)
+            study = svc.registry.get("s")
+            _drive(svc, "s", AP["n_startup_jobs"] + 1)  # past startup
+            # suggest that fails AFTER the seed draw (prepare breaks)
+            real_prepare = study.prepare
+            def broken(ids, seed):
+                raise RuntimeError("study-local failure")
+            study.prepare = broken
+            with pytest.raises(RuntimeError):
+                svc.suggest("s")
+            study.prepare = real_prepare
+            ok = _drive(svc, "s", 1)  # commits a LATER draw position
+            n_drawn = study.n_seeds_drawn
+        finally:
+            svc.close()
+        svc2 = OptimizationService(root=root, batch_window=0.001)
+        try:
+            recovered = svc2.registry.get("s")
+            # the failed draw sits between committed ones: the cursor
+            # must cover it, so the next suggest continues the stream
+            assert recovered.n_seeds_drawn == n_drawn
+            (t,) = svc2.suggest("s")
+            assert t["tid"] > ok[0]["tid"]
+        finally:
+            svc2.close()
+
+    def test_studies_gauge_set_after_recovery(self, tmp_path):
+        root = str(tmp_path / "r")
+        svc = OptimizationService(root=root)
+        try:
+            svc.create_study("g", SPACE)
+        finally:
+            svc.close()
+        svc2 = OptimizationService(root=root)
+        try:
+            assert svc2.stats.summary()["n_studies"] == 1
+        finally:
+            svc2.close()
+
+    def test_rand_algo_serves_inline(self):
+        svc = OptimizationService(root=None)
+        try:
+            svc.create_study("r", SPACE, seed=7, algo="rand")
+            _drive(svc, "r", 5)
+            s = svc.stats.summary()
+            assert s["n_inline_suggests"] == 5
+            assert s["n_dispatches"] == 0
+        finally:
+            svc.close()
+
+    def test_error_report_excluded_from_history(self):
+        svc = OptimizationService(root=None)
+        try:
+            svc.create_study("e", SPACE, seed=0, algo_params=AP)
+            (t,) = svc.suggest("e")
+            svc.report("e", t["tid"], status="fail")
+            st = svc.study_status("e")
+            assert st["n_completed"] == 0
+            assert st["n_trials"] == 1
+            # the run continues past the failure
+            (t2,) = svc.suggest("e")
+            assert t2["tid"] == t["tid"] + 1
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------
+# durability: drain + restart recovery
+# ---------------------------------------------------------------------
+
+
+class TestDurability:
+    def test_restart_continues_exact_trajectory(self, tmp_path):
+        root_split = str(tmp_path / "split")
+        root_full = str(tmp_path / "full")
+        n_first, n_total = 5, 10
+
+        svc = OptimizationService(root=root_split, batch_window=0.001)
+        try:
+            svc.create_study("s", SPACE, seed=11, algo_params=AP)
+            first = _drive(svc, "s", n_first)
+        finally:
+            svc.close()  # graceful drain; state is write-through
+
+        # a NEW server process on the same root recovers the study
+        svc2 = OptimizationService(root=root_split, batch_window=0.001)
+        try:
+            assert svc2.list_studies() == ["s"]
+            st = svc2.study_status("s")
+            assert st["n_completed"] == n_first
+            assert st["n_suggests"] == n_first
+            rest = _drive(svc2, "s", n_total - n_first)
+        finally:
+            svc2.close()
+
+        # the uninterrupted twin
+        svc3 = OptimizationService(root=root_full, batch_window=0.001)
+        try:
+            svc3.create_study("s", SPACE, seed=11, algo_params=AP)
+            full = _drive(svc3, "s", n_total)
+        finally:
+            svc3.close()
+
+        got = first + rest
+        assert len(got) == len(full) == n_total
+        for i, (g, f) in enumerate(zip(got, full)):
+            assert g["tid"] == f["tid"]
+            assert g["vals"].keys() == f["vals"].keys(), (i, g, f)
+            for k in g["vals"]:
+                assert np.isclose(g["vals"][k], f["vals"][k]), (i, k, g, f)
+
+    def test_suggested_but_unreported_trials_survive(self, tmp_path):
+        root = str(tmp_path / "r")
+        svc = OptimizationService(root=root, batch_window=0.001)
+        try:
+            svc.create_study("s", SPACE, seed=3)
+            (t,) = svc.suggest("s")
+        finally:
+            svc.close()
+        svc2 = OptimizationService(root=root)
+        try:
+            st = svc2.study_status("s")
+            assert st["n_trials"] == 1
+            assert st["states"][str(JOB_STATE_NEW)] == 1
+            # the doc is recoverable: reporting it after restart works
+            svc2.report("s", t["tid"], loss=1.0)
+            assert svc2.study_status("s")["n_completed"] == 1
+        finally:
+            svc2.close()
+
+    def test_space_roundtrip(self):
+        blob = encode_space(SPACE)
+        space2 = decode_space(blob)
+        assert set(space2) == set(SPACE)
+
+
+# ---------------------------------------------------------------------
+# HTTP plane
+# ---------------------------------------------------------------------
+
+
+class TestHTTP:
+    def test_end_to_end(self, tmp_path):
+        with ServiceServer(
+            OptimizationService(root=str(tmp_path / "q"),
+                                batch_window=0.004)
+        ) as server:
+            client = ServiceClient(server.url)
+            assert client.healthz()
+            client.create_study("h1", SPACE, seed=0, algo_params=AP)
+            client.create_study("h2", SPACE, seed=1, algo_params=AP)
+            assert client.list_studies() == ["h1", "h2"]
+            for sid in ("h1", "h2"):
+                for _ in range(6):
+                    (t,) = client.suggest(sid)
+                    point = space_eval(SPACE, t["vals"])
+                    client.report(sid, t["tid"], loss=_objective(point))
+            st = client.study_status("h1")
+            assert st["n_completed"] == 6
+            assert st["best"] is not None
+            metrics = client.metrics()
+            assert "hyperopt_service_requests_total" in metrics
+            assert 'endpoint="suggest"' in metrics
+            assert "hyperopt_service_batch_occupancy" in metrics
+            status = client.service_status()
+            assert status["studies"] == 2
+            assert status["stats"]["requests"]["suggest"] == 12
+
+    def test_http_backpressure_is_retryable_429(self):
+        with ServiceServer(
+            OptimizationService(root=None, max_queue=0)
+        ) as server:
+            client = ServiceClient(server.url, retry_timeout=0.0)
+            client.create_study("s", SPACE)
+            with pytest.raises(BackpressureError):
+                client.suggest("s")
+
+    def test_http_error_mapping(self):
+        from hyperopt_tpu.service import ServiceClientError
+
+        with ServiceServer(OptimizationService(root=None)) as server:
+            client = ServiceClient(server.url)
+            with pytest.raises(ServiceClientError) as e:
+                client.study_status("missing")
+            assert e.value.status == 404
+            client.create_study("s", SPACE)
+            with pytest.raises(ServiceClientError) as e:
+                client.create_study("s", SPACE)
+            assert e.value.status == 409
+            with pytest.raises(ServiceClientError) as e:
+                client._request("POST", "/v1/studies/s/report",
+                                {"no_tid": 1})
+            assert e.value.status == 400
+
+    def test_minimize_loop(self):
+        with ServiceServer(OptimizationService(root=None)) as server:
+            client = ServiceClient(server.url)
+            st = client.minimize(
+                "m", _objective, SPACE, max_evals=8, seed=5,
+                algo_params=AP,
+            )
+            assert st["n_completed"] == 8
+            assert st["best"]["loss"] <= 40.0
+
+    def test_shutdown_endpoint_drains_and_stops(self, tmp_path):
+        server = ServiceServer(
+            OptimizationService(root=str(tmp_path / "q"))
+        ).start()
+        client = ServiceClient(server.url)
+        client.create_study("s", SPACE)
+        client.shutdown()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                client.healthz()
+                time.sleep(0.1)
+            except Exception:
+                break
+        else:
+            pytest.fail("server did not stop after /v1/shutdown")
+        server.stop()  # idempotent
+        # new submits are rejected, not hung
+        with pytest.raises(Exception):
+            ServiceClient(server.url, timeout=2,
+                          retry_timeout=0).healthz()
+
+
+# ---------------------------------------------------------------------
+# CLI (python -m hyperopt_tpu.service) — true subprocess E2E
+# ---------------------------------------------------------------------
+
+
+class TestServiceCLI:
+    def test_cli_serves_and_sigterm_drains(self, tmp_path):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [ROOT] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+        )
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "hyperopt_tpu.service",
+                "--root", str(tmp_path / "svc"),
+                "--port", "0",
+            ],
+            env=env, cwd=ROOT,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        )
+        try:
+            url = proc.stdout.readline().strip()
+            assert url.startswith("http://127.0.0.1:"), url
+            client = ServiceClient(url)
+            client.create_study("cli", SPACE, seed=0, algo="rand")
+            (t,) = client.suggest("cli")
+            client.report("cli", t["tid"], loss=1.0)
+            assert client.study_status("cli")["n_completed"] == 1
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=60)
+            assert rc == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------
+# worker CLI graceful shutdown (satellite)
+# ---------------------------------------------------------------------
+
+
+class TestWorkerGracefulShutdown:
+    WSPACE = {"x": hp.uniform("x", -5, 5)}
+
+    def _env(self):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [ROOT, os.path.join(ROOT, "tests")]
+            + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+        )
+        env["JAX_PLATFORMS"] = "cpu"
+        return env
+
+    def _spawn_worker(self, qdir, tmp_path, extra=()):
+        return subprocess.Popen(
+            [
+                sys.executable, "-m", "hyperopt_tpu.parallel.worker",
+                "--queue", qdir,
+                "--poll-interval", "0.05",
+                "--reserve-timeout", "60",
+                "--workdir", str(tmp_path / "w"),
+            ] + list(extra),
+            env=self._env(), cwd=ROOT,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    def test_sigterm_mid_trial_finishes_and_exits_zero(self, tmp_path):
+        from worker_objective_helper import slow_quad_objective
+
+        from hyperopt_tpu.parallel.file_trials import FileTrials
+
+        qdir = str(tmp_path / "q")
+        trials = FileTrials(qdir)
+        domain = Domain(slow_quad_objective, self.WSPACE)
+        trials.attachments["FMinIter_Domain"] = pickle.dumps(domain)
+        docs = rand.suggest(trials.new_trial_ids(1), domain, trials, 0)
+        trials.insert_trial_docs(docs)
+        tid = docs[0]["tid"]
+
+        proc = self._spawn_worker(qdir, tmp_path)
+        try:
+            # wait until the worker has reserved the trial (RUNNING)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                doc = trials.jobs.read_doc(tid)
+                if doc is not None and doc["state"] != JOB_STATE_NEW:
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail("worker never reserved the trial")
+            # SIGTERM lands mid-objective (the objective sleeps ~2s)
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+        assert rc == 0
+        doc = trials.jobs.read_doc(tid)
+        assert doc["state"] == JOB_STATE_DONE  # trial finished, not lost
+        # lock AND lease released — nothing stranded for the reaper
+        assert not os.path.exists(trials.jobs.lock_path(tid))
+        assert not os.path.exists(trials.jobs.lease_path(tid))
+
+    def test_sigterm_during_reserve_wait_exits_promptly(self, tmp_path):
+        qdir = str(tmp_path / "q")
+        from hyperopt_tpu.parallel.file_trials import FileTrials
+
+        FileTrials(qdir)  # create the (empty) queue layout
+        proc = self._spawn_worker(qdir, tmp_path)
+        try:
+            time.sleep(3.0)  # let it enter the reserve poll loop
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+        assert rc == 0
+
+
+# ---------------------------------------------------------------------
+# stats + Prometheus rendering (satellite)
+# ---------------------------------------------------------------------
+
+
+class TestServiceStats:
+    def test_occupancy_and_latency(self):
+        s = ServiceStats()
+        assert s.mean_batch_occupancy is None
+        s.record_dispatch(3, 0.010)
+        s.record_dispatch(1, 0.005)
+        assert s.mean_batch_occupancy == 2.0
+        for ms in (1, 2, 100):
+            s.record_request("suggest", seconds=ms / 1e3, study="a")
+        q = s.latency_quantiles()
+        assert q["p50_ms"] == pytest.approx(2.0, abs=0.1)
+        assert q["p99_ms"] > 50
+        summ = s.summary()
+        assert summ["study_suggests"] == {"a": 3}
+        assert summ["n_dispatches"] == 2
+
+    def test_rejections_and_gauges(self):
+        s = ServiceStats()
+        s.record_rejection("suggest")
+        s.set_queue_depth(5)
+        s.set_n_studies(2)
+        summ = s.summary()
+        assert summ["rejected"] == {"suggest": 1}
+        assert summ["queue_depth"] == 5
+        assert summ["n_studies"] == 2
+
+
+class TestRenderPrometheus:
+    def test_all_sections_render(self):
+        timings = PhaseTimings()
+        timings.record("suggest", 0.25)
+        spec = SpeculationStats()
+        spec.record_dispatch(0.1)
+        spec.record_sync(0.2)
+        faults = FaultStats()
+        faults.record("device_reinit")
+        faults.record_backoff(1.5)
+        service = ServiceStats()
+        service.record_request("suggest", seconds=0.01, study="s")
+        service.record_dispatch(2, 0.02)
+        text = render_prometheus(
+            timings=timings, speculation=spec, faults=faults,
+            service=service, extra={"uptime_seconds": 12.5},
+        )
+        for needle in (
+            '# TYPE hyperopt_phase_seconds_total counter',
+            'hyperopt_phase_seconds_total{phase="suggest"} 0.25',
+            'hyperopt_speculation_events_total{event="dispatched"} 1.0',
+            'hyperopt_fault_events_total{event="device_reinit"} 1.0',
+            'hyperopt_fault_backoff_seconds_total 1.5',
+            'hyperopt_service_requests_total{endpoint="suggest"} 1.0',
+            'hyperopt_service_batch_occupancy 2.0',
+            'hyperopt_service_suggest_latency_ms{quantile="0.5"}',
+            'hyperopt_uptime_seconds 12.5',
+        ):
+            assert needle in text, needle
+        assert text.endswith("\n")
+
+    def test_label_escaping_and_nan(self):
+        s = ServiceStats()
+        s.record_request("suggest", seconds=0.01, study='we"ird\nname')
+        text = render_prometheus(service=s)
+        assert 'study="we\\"ird\\nname"' in text
+        # occupancy has no dispatches yet -> NaN, not a crash
+        assert "hyperopt_service_batch_occupancy NaN" in text
+
+    def test_empty_render(self):
+        assert render_prometheus() == "\n"

@@ -9,3 +9,12 @@ worker *process* must re-import it.
 
 def quad_objective(cfg):
     return (cfg["x"] - 3.0) ** 2
+
+
+def slow_quad_objective(cfg):
+    """~2s objective for the graceful-shutdown test: long enough to land
+    a SIGTERM while the trial is in flight, short enough for CI."""
+    import time
+
+    time.sleep(2.0)
+    return (cfg["x"] - 3.0) ** 2
