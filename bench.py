@@ -499,6 +499,43 @@ def chaos_section(argv):
     return 0 if report["ok"] else 1
 
 
+def chaos_serve_section(argv):
+    """``python bench.py --chaos-serve [--quick]``: service-plane
+    exactly-once smoke — a short seeded chaos-serve campaign
+    (scripts/chaos_serve_campaign.py) on CPU: server SIGKILLs (scheduled
+    and mid-torn-write), connection resets before/after response commit,
+    and slow-loris clients against retrying idempotent clients; asserts
+    zero lost/duplicated trials, fsck clean, per-study trajectories
+    identical to the fault-free twin, and byte-identical journal
+    replays.  Prints ONE JSON line like the other bench sections."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    chaos_serve = _import_script("chaos_serve_campaign")
+    quick = "--quick" in argv
+    t0 = time.time()
+    report = chaos_serve.run_campaign(
+        n_studies=4 if quick else 8,
+        n_trials=6 if quick else 12,
+        min_kills=2 if quick else 3,
+        quick=quick,
+    )
+    out = {
+        "metric": "chaos_serve_smoke",
+        "value": report["total_injected"],
+        "unit": "injected_faults",
+        "ok": report["ok"],
+        "server_kills": report["server_kills"],
+        "lost_trials": report["integrity"]["lost_trials"],
+        "duplicated_trials": report["integrity"]["duplicated_trials"],
+        "trajectories_match": report["trajectories_match_fault_free"],
+        "fsck_clean": report["fsck_after_repair"]["clean"],
+        "replay_ok": report["replay"]["ok"],
+        "errors": report["errors"],
+        "elapsed_s": round(time.time() - t0, 2),
+    }
+    print(json.dumps(out))
+    return 0 if report["ok"] else 1
+
+
 def serve_section(argv):
     """``python bench.py --serve [--quick]``: optimization-service smoke —
     a short seeded multi-study loadgen run on CPU
@@ -540,6 +577,9 @@ def main():
     if "--lint" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--lint"]
         return lint_section(argv)
+    if "--chaos-serve" in sys.argv[1:]:
+        argv = [a for a in sys.argv[1:] if a != "--chaos-serve"]
+        return chaos_serve_section(argv)
     if "--chaos" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--chaos"]
         return chaos_section(argv)

@@ -76,9 +76,13 @@ RACE_LINT_FILES = (
     os.path.join(_PKG_ROOT, "resilience", "leases.py"),
     os.path.join(_PKG_ROOT, "resilience", "device.py"),
     os.path.join(_PKG_ROOT, "resilience", "chaos.py"),
+    # the client-side circuit breaker: shared by every calling thread
+    os.path.join(_PKG_ROOT, "resilience", "retry.py"),
     # the optimization service: HTTP handler threads submit/report while
-    # the scheduler thread batches — queue and registry carry guards
+    # the scheduler thread batches — queue, registry, and the exactly-
+    # once response journal carry guards
     os.path.join(_PKG_ROOT, "service", "core.py"),
+    os.path.join(_PKG_ROOT, "service", "client.py"),
 )
 
 

@@ -275,6 +275,7 @@ class ServiceStats:
         self._lock = threading.Lock()
         self._requests = defaultdict(int)       # endpoint -> served
         self._rejected = defaultdict(int)       # endpoint -> 429s
+        self._replayed = defaultdict(int)       # endpoint -> journal hits
         self._study_suggests = defaultdict(int)  # study -> suggests served
         # ring buffer: a long-lived server's quantiles must track the
         # CURRENT traffic, not freeze on the first N samples
@@ -298,6 +299,12 @@ class ServiceStats:
     def record_rejection(self, endpoint: str):
         with self._lock:
             self._rejected[endpoint] += 1
+
+    def record_replay(self, endpoint: str):
+        """A retried request answered from the idempotency journal —
+        exactly-once doing its job (no seed consumed, no state change)."""
+        with self._lock:
+            self._replayed[endpoint] += 1
 
     def record_dispatch(self, n_requests: int, seconds: float):
         """One fused device program carrying ``n_requests`` suggests."""
@@ -353,6 +360,7 @@ class ServiceStats:
             return {
                 "requests": dict(sorted(self._requests.items())),
                 "rejected": dict(sorted(self._rejected.items())),
+                "idempotent_replays": dict(sorted(self._replayed.items())),
                 "study_suggests": dict(sorted(self._study_suggests.items())),
                 "n_dispatches": self._n_dispatches,
                 "n_batched_suggests": self._n_batched,
@@ -475,6 +483,14 @@ def render_prometheus(
              "Requests rejected with backpressure per endpoint.", "counter")
         for endpoint, n in s["rejected"].items():
             sample("service_rejected_total", {"endpoint": endpoint}, n)
+        head("service_idempotent_replays_total",
+             "Retried requests answered from the response journal.",
+             "counter")
+        for endpoint, n in s.get("idempotent_replays", {}).items():
+            sample(
+                "service_idempotent_replays_total",
+                {"endpoint": endpoint}, n,
+            )
         head("service_study_suggests_total",
              "Suggest requests served per study.", "counter")
         for study, n in s["study_suggests"].items():

@@ -40,10 +40,12 @@ import json
 import logging
 import os
 import pickle
+import re
 import socket
 import sys
 import threading
 import time
+import zlib
 from collections.abc import MutableMapping
 
 from .. import native as _native
@@ -103,19 +105,90 @@ def _atomic_write(path, data: bytes):
     os.replace(tmp, path)
 
 
-def _write_doc(path, doc):
-    _atomic_write(
-        path, json.dumps(doc, default=_json_default, sort_keys=True).encode()
+# Crash-consistency trailer on every trial doc: `\n#crc32:<crc>:<len>\n`
+# appended after the JSON payload.  A torn disk write (power loss, a
+# writer SIGKILL'd by the chaos harness mid-write) truncates or garbles
+# the payload; the trailer lets `_read_doc` tell "torn" apart from
+# "racing an atomic replace" and quarantine the file instead of crashing
+# `all_docs`.  A JSON comment-style line after the payload is invisible
+# to the native fast scanner (it greps for `"state":` textually) and to
+# any legacy doc without one (the trailer is optional on read).
+_DOC_TRAILER_RE = re.compile(rb"\n#crc32:([0-9a-f]{8}):(\d+)\n?$")
+
+
+def _encode_doc(doc) -> bytes:
+    payload = json.dumps(doc, default=_json_default, sort_keys=True).encode()
+    return payload + b"\n#crc32:%08x:%d\n" % (
+        zlib.crc32(payload) & 0xFFFFFFFF, len(payload)
     )
 
 
-def _read_doc(path):
+class DocCorrupt(ValueError):
+    """A trial doc failed its CRC/length trailer or does not parse."""
+
+
+def _decode_doc(raw: bytes):
+    """Parse one doc blob, verifying the CRC trailer when present.
+    Raises :class:`DocCorrupt` for torn/garbled payloads."""
+    m = _DOC_TRAILER_RE.search(raw)
+    if m is not None:
+        length = int(m.group(2))
+        payload = raw[:m.start()]
+        if len(payload) != length or (
+            zlib.crc32(payload) & 0xFFFFFFFF
+        ) != int(m.group(1), 16):
+            raise DocCorrupt("doc payload fails its length/CRC32 trailer")
+    else:
+        payload = raw  # legacy doc written before the trailer existed
+    try:
+        return json.loads(payload.decode(), object_hook=_json_object_hook)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise DocCorrupt(str(e))
+
+
+def quarantine_path(path) -> str:
+    """Destination a corrupt doc is renamed to (never re-globbed as a
+    trial doc: the ``.corrupt`` suffix defeats both the ``*.json`` glob
+    and the native scanner's name filter)."""
+    dest = f"{path}.corrupt"
+    if os.path.exists(dest):  # a second tear of the same tid
+        dest = f"{path}.corrupt.{time.monotonic_ns()}"
+    return dest
+
+
+def attachment_filename(key) -> str:
+    """THE attachment-key → filename sanitization.  Shared with
+    resilience.fsck, which must read exactly the files the queue
+    writes — a second copy of this mapping could silently diverge."""
+    return str(key).replace("/", "_").replace(":", "_")
+
+
+def _write_doc(path, doc):
+    _atomic_write(path, _encode_doc(doc))
+
+
+def _read_doc(path, quarantine=True):
     for _ in range(5):
         try:
             with open(path, "rb") as f:
-                return json.loads(f.read().decode(), object_hook=_json_object_hook)
-        except (json.JSONDecodeError, FileNotFoundError):
+                raw = f.read()
+        except FileNotFoundError:
             time.sleep(0.01)  # racing an atomic replace; retry
+            continue
+        try:
+            return _decode_doc(raw)
+        except DocCorrupt:
+            time.sleep(0.01)  # a re-write may be landing; re-read
+    if quarantine and os.path.exists(path):
+        # persistently corrupt: a torn write, not a race.  Move it aside
+        # so all_docs/fsck stop tripping on it; the service journal (or
+        # an operator) can restore the doc from its own record.
+        dest = quarantine_path(path)
+        try:
+            os.replace(path, dest)
+            logger.warning("quarantined corrupt doc %s -> %s", path, dest)
+        except OSError:
+            logger.warning("could not quarantine corrupt doc %s", path)
     return None
 
 
@@ -150,8 +223,9 @@ class FileJobs:
         return os.path.join(self.root, "leases", f"{int(tid):012d}.lease")
 
     def attachment_path(self, key):
-        safe = key.replace("/", "_").replace(":", "_")
-        return os.path.join(self.root, "attachments", safe)
+        return os.path.join(
+            self.root, "attachments", attachment_filename(key)
+        )
 
     # -- id allocation --------------------------------------------------
     def new_trial_ids(self, n):
@@ -181,8 +255,11 @@ class FileJobs:
                         f"already-allocated id {self._last_id} (rolled-back "
                         f"or truncated queue directory?)"
                     )
-                with open(counter, "w") as f:
-                    f.write(str(start + n))
+                # atomic replace, not truncate-then-write: a writer
+                # SIGKILL'd between the truncate and the write would
+                # leave an EMPTY counter, and the next reader would
+                # restart ids at 0 — duplicate tids
+                _atomic_write(counter, str(start + n).encode())
                 self._last_id = start + n - 1
                 return list(range(start, start + n))
             finally:
@@ -201,9 +278,13 @@ class FileJobs:
         chaos = _active_chaos()
         if chaos is not None:
             chaos.maybe_torn_lock(self, doc["tid"])
+            chaos.maybe_torn_doc(self.trial_path(doc["tid"]), doc["tid"])
 
     def write(self, doc):
         _write_doc(self.trial_path(doc["tid"]), doc)
+        chaos = _active_chaos()
+        if chaos is not None:
+            chaos.maybe_torn_doc(self.trial_path(doc["tid"]), doc["tid"])
 
     def read_doc(self, tid):
         """One trial doc by id (None if absent/unreadable)."""
@@ -227,6 +308,39 @@ class FileJobs:
             except ValueError:
                 continue
         return sorted(out)
+
+    def tmp_droppings(self):
+        """`*.tmp.*` files a writer killed between ``open`` and
+        ``os.replace`` in ``_atomic_write`` left behind, across every
+        queue subdirectory.  Invisible to doc globs (the names end in
+        pid/nanosecond digits, not ``.json``) but they accumulate
+        forever without a GC."""
+        out = []
+        for sub in ("trials", "locks", "leases", "attachments"):
+            out.extend(
+                glob.glob(os.path.join(self.root, sub, "*.tmp.*"))
+            )
+        # the id counter's atomic-replace tmp lives at the queue root
+        out.extend(glob.glob(os.path.join(self.root, "*.tmp.*")))
+        return sorted(out)
+
+    def gc_tmp_droppings(self, max_age_secs=None) -> int:
+        """Delete tmp droppings older than ``max_age_secs`` (default:
+        the lease TTL — younger ones may be a write in flight)."""
+        max_age = (
+            self.lease_ttl if max_age_secs is None else float(max_age_secs)
+        )
+        now = time.time()
+        n = 0
+        for p in self.tmp_droppings():
+            try:
+                if now - os.path.getmtime(p) <= max_age:
+                    continue
+                os.unlink(p)
+                n += 1
+            except OSError:
+                continue  # vanished under us, or unreadable mtime
+        return n
 
     # -- leases ----------------------------------------------------------
     # Reservations are renewable heartbeat leases: ``reserve`` grants one,
@@ -256,8 +370,8 @@ class FileJobs:
         except FileNotFoundError:
             return None
         try:
-            return json.loads(raw.decode())
-        except (json.JSONDecodeError, UnicodeDecodeError):
+            return _decode_doc(raw)
+        except DocCorrupt:
             return None  # torn write: the reaper treats it as expired
 
     def renew_lease(self, tid, owner, ttl=None):
@@ -425,7 +539,9 @@ class FileJobs:
     def requeue_stale(self, max_age_secs):
         """Re-queue RUNNING trials whose reservation is older than
         ``max_age_secs`` (recovery beyond the reference's capability —
-        Mongo leaves dead workers' jobs reserved forever)."""
+        Mongo leaves dead workers' jobs reserved forever).  Also GCs the
+        ``*.tmp.*`` droppings a writer killed mid-``_atomic_write``
+        leaves behind — scripted cleanup must not strand them."""
         n = 0
         now = coarse_utcnow()
         for doc in self.all_docs():
@@ -443,6 +559,7 @@ class FileJobs:
                 doc["book_time"] = None
                 self.write(doc)
                 n += 1
+        self.gc_tmp_droppings(max_age_secs)
         return n
 
     # -- attachments -----------------------------------------------------
@@ -538,6 +655,12 @@ class FileTrials(Trials):
 
     def delete_all(self):
         for p in glob.glob(os.path.join(self.jobs.root, "trials", "*.json")):
+            os.unlink(p)
+        for p in glob.glob(
+            os.path.join(self.jobs.root, "trials", "*.corrupt*")
+        ):
+            os.unlink(p)
+        for p in self.jobs.tmp_droppings():
             os.unlink(p)
         for p in glob.glob(os.path.join(self.jobs.root, "locks", "*.lock")):
             os.unlink(p)

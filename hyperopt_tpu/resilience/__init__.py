@@ -22,16 +22,25 @@ spanning four layers:
   re-initialization and a CPU-backend fallback that continues the run.
 - :mod:`.chaos` — deterministic, seed-reproducible fault injection
   (worker kills, torn locks, delayed/duplicated results, objective
-  exceptions/NaNs/hangs, synthetic device errors) for tests and
-  ``scripts/chaos_campaign.py``.
+  exceptions/NaNs/hangs, synthetic device errors, and the service-plane
+  sites: server SIGKILL, connection resets, torn doc/journal writes,
+  slow-loris clients) for tests, ``scripts/chaos_campaign.py``, and
+  ``scripts/chaos_serve_campaign.py``.
+- :mod:`.fsck` — offline detect-and-repair for the durable trial store
+  (torn docs, orphan leases/locks, duplicate tids, stale seed cursors,
+  tmp droppings, torn response journals); run at server startup and via
+  ``python -m hyperopt_tpu.service fsck``.
 
 All recovery events flow into :class:`hyperopt_tpu.observability.FaultStats`
 counters; see ``docs/resilience.md`` for the protocols and knobs.
 """
 
 from .device import DeviceRecovery, SyntheticDeviceError, is_device_error
+from .fsck import FsckReport, fsck_path, fsck_queue, fsck_service_root
 from .leases import LeaseReaper
 from .retry import (
+    CircuitBreaker,
+    CircuitOpenError,
     RetryPolicy,
     TrialQuarantined,
     TrialTimeout,
@@ -41,7 +50,10 @@ from .retry import (
 )
 
 __all__ = [
+    "CircuitBreaker",
+    "CircuitOpenError",
     "DeviceRecovery",
+    "FsckReport",
     "LeaseReaper",
     "RetryPolicy",
     "SyntheticDeviceError",
@@ -49,6 +61,9 @@ __all__ = [
     "TrialTimeout",
     "backoff_delay",
     "execute_with_retry",
+    "fsck_path",
+    "fsck_queue",
+    "fsck_service_root",
     "is_device_error",
     "run_with_timeout",
 ]

@@ -36,6 +36,32 @@ Injected fault classes (ISSUE archetype list):
   :class:`~hyperopt_tpu.resilience.device.DeviceRecovery` re-init / CPU
   fallback; the speculative engine discards and re-issues cleanly.
 
+Service-plane fault classes (ISSUE 5), aimed at the optimization
+server's HTTP edge and its crash-consistent store:
+
+- **server SIGKILL mid-batch** — the chaos-serve campaign's supervisor
+  rolls ``should_kill_server`` per completed trial and ``kill -9``s the
+  server process at the hits.  Recovery: startup fsck + response-journal
+  replay + seed-cursor re-verification; clients retry through the
+  outage with idempotency keys.
+- **connection reset before/after response commit** — the HTTP handler
+  drops the connection without a response, either before any state
+  change (client retry is trivially safe) or after the journal+store
+  commit (client retry replays the journaled response byte-for-byte).
+- **torn doc / torn journal writes** — a trial doc is truncated in
+  place after its atomic write (latent disk corruption discovered at
+  the next read/restart: the CRC trailer detects it and quarantines),
+  or the append-only response journal loses the tail of its last
+  record (the per-line CRC detects it; replay of a lost tail entry is
+  safe because the entry's effects had not landed either).
+- **slow-loris clients** — the campaign parks sockets that trickle a
+  request forever; the handler's read timeout bounds the damage to one
+  handler thread per socket.
+
+Every service-plane injection can be appended to a crash-surviving
+``injection_log`` (JSONL, ``O_APPEND``) so a campaign can reconcile
+injected-fault counts across server kills.
+
 Activate with :func:`active` (a context manager setting the process-wide
 monkey); the production code paths cost one ``sys.modules`` lookup when
 the harness was never imported.  Every injection is counted in the
@@ -48,7 +74,9 @@ from __future__ import annotations
 
 import contextlib
 import hashlib
+import json
 import logging
+import os
 import threading
 import time
 from collections import defaultdict
@@ -89,6 +117,40 @@ class ChaosConfig:
     p_device_error: float = 0.0
     hang_seconds: float = 1.0
     delay_seconds: float = 0.5
+    # service-plane sites (chaos-serve campaign)
+    p_server_kill: float = 0.0
+    p_conn_reset_pre: float = 0.0
+    p_conn_reset_post: float = 0.0
+    p_torn_doc: float = 0.0
+    p_torn_journal: float = 0.0
+    p_slow_loris: float = 0.0
+    # crash-consistent tears: a REAL torn write only damages data whose
+    # fsync never returned — i.e. it happens AT a crash, and the write
+    # was never acknowledged downstream.  With this flag (the default)
+    # a torn doc/journal site tears the file and then SIGKILLs its own
+    # process mid-write, exactly that semantics.  False gives a plain
+    # in-place tear (a lying disk) for unit tests of the detectors —
+    # a model under which NO single-copy store can avoid data loss once
+    # both the doc and its journal record rot independently.
+    tear_kills_process: bool = True
+    # crash-surviving injection record (JSONL, appended O_APPEND): lets
+    # a campaign count injections made by a process that was later
+    # SIGKILL'd.  None disables.
+    injection_log: str | None = None
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {f: getattr(self, f) for f in self.__dataclass_fields__},
+            sort_keys=True,
+        )
+
+    @classmethod
+    def from_json(cls, blob: str) -> "ChaosConfig":
+        d = json.loads(blob)
+        known = {
+            k: v for k, v in d.items() if k in cls.__dataclass_fields__
+        }
+        return cls(**known)
 
 
 def stable_key(cfg) -> str:
@@ -109,21 +171,80 @@ class ChaosMonkey:
         self._roll_lock = threading.Lock()
         self._occurrence = defaultdict(int)  # guarded-by: _roll_lock
         self._installed_observer = None
+        self._replay_injection_log()
+
+    def _replay_injection_log(self):
+        """Restore occurrence counters from the crash-surviving log.
+
+        "Transient faults stay transient" must hold across process
+        death too: a tear site that SIGKILLs its own process would
+        otherwise re-roll the retried write at occurrence 0 in the
+        restarted server — same hash, same hit, a deterministic crash
+        loop.  Replaying the log advances each ``(site, key)`` past its
+        already-injected occurrences, so the retry rolls fresh."""
+        if not self.config.injection_log:
+            return
+        try:
+            with open(self.config.injection_log, "rb") as f:
+                raw = f.read()
+        except OSError:
+            return
+        with self._roll_lock:
+            for line in raw.split(b"\n"):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line.decode())
+                    site, key = rec["site"], rec["key"]
+                    occ = int(rec["occurrence"])
+                except (json.JSONDecodeError, UnicodeDecodeError,
+                        KeyError, TypeError, ValueError):
+                    continue  # the torn tail of a mid-append SIGKILL
+                if self._occurrence[(site, key)] <= occ:
+                    self._occurrence[(site, key)] = occ + 1
 
     # -- the deterministic roll ----------------------------------------
     def _roll(self, site: str, key, p: float) -> bool:
         if p <= 0.0:
             return False
+        # occurrence is tracked by the key's STRING form — the hash
+        # below already stringifies, and the injection-log replay can
+        # then restore counters across a process death
+        skey = str(key)
         with self._roll_lock:
-            occ = self._occurrence[(site, key)]
-            self._occurrence[(site, key)] = occ + 1
+            occ = self._occurrence[(site, skey)]
+            self._occurrence[(site, skey)] = occ + 1
         h = hashlib.sha256(
             f"{self.config.seed}:{site}:{key}:{occ}".encode()
         ).digest()
         hit = int.from_bytes(h[:8], "big") / 2 ** 64 < p
         if hit:
             self.stats.record(f"chaos_{site}")
+            self._log_injection(site, skey, occ)
         return hit
+
+    def _log_injection(self, site, key, occ):
+        """Append one injection record to the crash-surviving log.
+        ``O_APPEND`` single-write: a SIGKILL mid-append tears at most
+        the final line, which the reader tolerates."""
+        if not self.config.injection_log:
+            return
+        line = json.dumps(
+            {"site": site, "key": str(key), "occurrence": occ},
+            sort_keys=True,
+        ) + "\n"
+        try:
+            fd = os.open(
+                self.config.injection_log,
+                os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644,
+            )
+            try:
+                os.write(fd, line.encode())
+            finally:
+                os.close(fd)
+        except OSError:
+            logger.warning("could not append injection log", exc_info=True)
 
     # -- worker-plane sites --------------------------------------------
     def maybe_kill_worker(self, tid, where: str = "mid"):
@@ -155,8 +276,6 @@ class ChaosMonkey:
         a worker that died inside its lock write."""
         if not self._roll("torn_lock", int(tid), self.config.p_torn_lock):
             return
-        import os
-
         lock = jobs.lock_path(tid)
         try:
             fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
@@ -193,6 +312,80 @@ class ChaosMonkey:
             return fn(cfg)
 
         return chaotic
+
+    # -- service-plane sites -------------------------------------------
+    @staticmethod
+    def _tear_file(path, drop_bytes=None):
+        """Truncate ``path`` in place — the on-disk shape of a write the
+        kernel never finished.  ``drop_bytes=None`` halves the file (a
+        torn doc); a positive value clips just the tail (a torn
+        append)."""
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return False
+        keep = size // 2 if drop_bytes is None else max(
+            0, size - int(drop_bytes)
+        )
+        if keep >= size:
+            keep = max(0, size - 1)
+        try:
+            with open(path, "r+b") as f:
+                f.truncate(keep)
+        except OSError:
+            return False
+        return True
+
+    def _die_mid_write(self):
+        """SIGKILL our own process — the write we just tore is now a
+        write the crash interrupted, never one the caller acknowledged."""
+        import signal as _signal
+
+        os.kill(os.getpid(), _signal.SIGKILL)
+
+    def maybe_torn_doc(self, path, tid):
+        """Tear a just-written trial doc: the CRC trailer detects it at
+        the next read and fsck quarantines/restores it.  With
+        ``tear_kills_process`` (default) the process dies mid-write —
+        the crash-consistent torn write."""
+        if not self._roll("torn_doc", int(tid), self.config.p_torn_doc):
+            return
+        if self._tear_file(path):
+            logger.info("chaos: tore doc for trial %s", tid)
+            if self.config.tear_kills_process:
+                self._die_mid_write()
+
+    def maybe_torn_journal(self, path, key):
+        """Clip the tail off the append-only response journal — a torn
+        final append.  The per-line CRC detects it; with
+        ``tear_kills_process`` (default) the process dies mid-append, so
+        the lost record is by construction one no client was answered
+        for."""
+        if not self._roll("torn_journal", key, self.config.p_torn_journal):
+            return
+        if self._tear_file(path, drop_bytes=7):
+            logger.info("chaos: tore journal tail at %s", path)
+            if self.config.tear_kills_process:
+                self._die_mid_write()
+
+    def should_reset_connection(self, route: str, key, when: str) -> bool:
+        """Roll a connection-reset site.  ``when`` is ``"pre"`` (drop
+        before any state change) or ``"post"`` (drop after the
+        journal+store commit, before the response bytes leave)."""
+        p = (
+            self.config.p_conn_reset_pre
+            if when == "pre"
+            else self.config.p_conn_reset_post
+        )
+        return self._roll(f"conn_reset_{when}", (route, key), p)
+
+    def should_kill_server(self, key) -> bool:
+        """One supervisor roll of the server-SIGKILL site (the campaign
+        rolls once per completed trial and kills at the hits)."""
+        return self._roll("server_kill", key, self.config.p_server_kill)
+
+    def should_slow_loris(self, key) -> bool:
+        return self._roll("slow_loris", key, self.config.p_slow_loris)
 
     # -- device-plane site ---------------------------------------------
     def maybe_device_error(self):

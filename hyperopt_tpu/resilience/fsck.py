@@ -1,0 +1,507 @@
+"""fsck for the durable trial store: detect and repair crash damage.
+
+``kill -9``, torn disk writes, and writers that died mid-operation leave
+a FileTrials queue directory (or a whole optimization-service root) in
+states the happy path never produces.  This module is the offline
+checker/repairer — run automatically by the optimization server before
+it admits traffic, and by hand via::
+
+    python -m hyperopt_tpu.service fsck <root>            # dry-run report
+    python -m hyperopt_tpu.service fsck <root> --repair   # fix what it finds
+
+Rule catalog (stable ids, mirroring the analysis passes' convention):
+
+========  ==============================================================
+FS401     torn/corrupt trial doc (fails its length+CRC32 trailer or does
+          not parse).  Repair: quarantine to ``<doc>.corrupt``; if the
+          study's response journal holds the doc, restore it.
+FS402     orphan lease (no trial doc, or the doc is not RUNNING).
+          Repair: delete the lease file.
+FS403     orphan/stale lock (no trial doc, or the doc is in a state that
+          cannot legitimately hold a reservation: NEW/DONE/ERROR).
+          Repair: delete the lock file.
+FS404     duplicate/mismatched tid (the doc's internal ``tid`` does not
+          match its filename — two files can then claim one tid).
+          Repair: quarantine the mismatched file.
+FS405     stale seed-cursor attachment (the service's durable cursor is
+          BEHIND the highest draw position evidenced by docs/journal —
+          a restart would re-issue a seed an existing trial already
+          used).  Repair: advance the attachment.
+FS406     tmp droppings (``*.tmp.*`` files from a writer killed between
+          ``open`` and ``os.replace`` in ``_atomic_write``).
+          Repair: delete.
+FS407     torn response-journal record (a line failing its per-record
+          CRC — a torn final append, or latent corruption).  Repair:
+          rewrite the journal keeping only the valid records.
+FS408     broken id allocator: a stuck ``ids.counter.lock`` (allocator
+          SIGKILL'd inside its critical section — every later
+          allocation would spin to a 30s timeout), or an
+          empty/regressed ``ids.counter`` at or below the highest tid
+          on disk (the next allocation would re-issue an existing tid).
+          Repair: delete the stuck lock / advance the counter past the
+          highest tid.
+========  ==============================================================
+
+Offline by design: run it on a queue no process is writing (the server
+runs it before starting its scheduler).  Repairs are individually
+crash-safe (atomic rename/replace or unlink).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from dataclasses import dataclass, field
+
+from ..base import (
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_RUNNING,
+    STATUS_FAIL,
+)
+from ..parallel.file_trials import (
+    DocCorrupt,
+    _decode_doc,
+    quarantine_path,
+)
+
+# states that can legitimately hold a reservation lock
+_LOCKABLE_STATES = (JOB_STATE_RUNNING,)
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    detail: str
+    repaired: bool = False
+    action: str = ""
+
+    def format(self) -> str:
+        mark = "FIXED" if self.repaired else "FOUND"
+        out = f"[{self.rule}] {mark} {self.path}: {self.detail}"
+        if self.action:
+            out += f" -> {self.action}"
+        return out
+
+
+@dataclass
+class FsckReport:
+    root: str
+    repair: bool
+    findings: list = field(default_factory=list)
+    n_docs: int = 0
+    n_queues: int = 0
+
+    def add(self, rule, path, detail, repaired=False, action=""):
+        self.findings.append(
+            Finding(rule, path, detail, repaired=repaired, action=action)
+        )
+
+    @property
+    def n_unrepaired(self) -> int:
+        return sum(1 for f in self.findings if not f.repaired)
+
+    @property
+    def clean(self) -> bool:
+        """True when the store is consistent NOW: either nothing was
+        found, or everything found was repaired."""
+        return self.n_unrepaired == 0
+
+    def by_rule(self) -> dict:
+        out = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return dict(sorted(out.items()))
+
+    def summary(self) -> dict:
+        return {
+            "root": self.root,
+            "repair": self.repair,
+            "clean": self.clean,
+            "n_queues": self.n_queues,
+            "n_docs": self.n_docs,
+            "n_findings": len(self.findings),
+            "n_unrepaired": self.n_unrepaired,
+            "by_rule": self.by_rule(),
+            "findings": [
+                {
+                    "rule": f.rule, "path": f.path, "detail": f.detail,
+                    "repaired": f.repaired, "action": f.action,
+                }
+                for f in self.findings
+            ],
+        }
+
+    def format(self) -> str:
+        lines = [
+            f"fsck {self.root}: {self.n_queues} queue(s), "
+            f"{self.n_docs} doc(s), {len(self.findings)} finding(s)"
+            + ("" if self.clean else f", {self.n_unrepaired} UNREPAIRED")
+        ]
+        lines.extend(f.format() for f in self.findings)
+        lines.append("clean" if self.clean else "NOT CLEAN")
+        return "\n".join(lines)
+
+
+def _tid_from_name(name, suffix):
+    stem = os.path.basename(name)
+    if not stem.endswith(suffix):
+        return None
+    try:
+        return int(stem[: -len(suffix)])
+    except ValueError:
+        return None
+
+
+def _attachment_path(qdir, key):
+    from ..parallel.file_trials import attachment_filename
+
+    return os.path.join(qdir, "attachments", attachment_filename(key))
+
+
+def _journal_path(qdir):
+    # lazy import: service -> resilience is the load-bearing direction;
+    # this reverse edge exists only for the journal's file format
+    from ..service.core import RESPONSE_JOURNAL_ATTACHMENT
+
+    return _attachment_path(qdir, RESPONSE_JOURNAL_ATTACHMENT)
+
+
+def _load_journal(qdir):
+    """(entries, n_torn, path) for the study's response journal (empty
+    when none exists)."""
+    from ..service.core import ResponseJournal
+
+    path = _journal_path(qdir)
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except FileNotFoundError:
+        return [], 0, path
+    entries, torn = ResponseJournal.parse_lines(raw)
+    entries.sort(key=lambda e: int(e.get("seq", 0)))
+    return entries, torn, path
+
+
+def fsck_queue(qdir, repair=False, report: FsckReport = None) -> FsckReport:
+    """Check (and optionally repair) ONE FileTrials queue directory."""
+    qdir = os.path.abspath(qdir)
+    if report is None:
+        report = FsckReport(root=qdir, repair=repair)
+    report.n_queues += 1
+
+    entries, n_torn, journal_file = _load_journal(qdir)
+    journal_docs = {}  # tid -> (doc, draw_index) recoverable from journal
+    journal_results = {}  # tid -> result from journaled reports
+    max_journal_draw = 0
+    for entry in entries:
+        if entry.get("kind") == "suggest":
+            max_journal_draw = max(
+                max_journal_draw, int(entry.get("draw_index", 0))
+            )
+            for doc in entry.get("docs") or []:
+                journal_docs[int(doc["tid"])] = (
+                    doc, entry.get("draw_index")
+                )
+        elif entry.get("kind") == "report":
+            journal_results[int(entry.get("tid", -1))] = entry.get("result")
+
+    # FS407: torn journal records
+    if n_torn:
+        fixed = False
+        action = ""
+        if repair:
+            from ..parallel.file_trials import _atomic_write
+            from ..service.core import ResponseJournal
+
+            try:
+                j = ResponseJournal(path=None)
+                blob = b"".join(j._format_record(e) for e in entries)
+                _atomic_write(journal_file, blob)
+                fixed = True
+                action = (
+                    f"rewrote journal keeping {len(entries)} valid "
+                    f"record(s)"
+                )
+            except OSError:
+                pass
+        report.add(
+            "FS407", journal_file,
+            f"{n_torn} torn journal record(s)",
+            repaired=fixed, action=action,
+        )
+
+    # -- scan the docs ---------------------------------------------------
+    docs_by_tid = {}
+    seen_states = {}
+    max_doc_draw = 0
+    for path in sorted(glob.glob(os.path.join(qdir, "trials", "*.json"))):
+        name_tid = _tid_from_name(path, ".json")
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            continue
+        try:
+            doc = _decode_doc(raw)
+        except DocCorrupt as e:
+            # FS401: torn/corrupt doc.  One unrepairable file (EACCES,
+            # vanished mid-scan) must degrade to a "found, unrepaired"
+            # finding, never abort the whole scan — the other queues
+            # still deserve their repairs.
+            action = ""
+            fixed = False
+            if repair:
+                try:
+                    dest = quarantine_path(path)
+                    os.replace(path, dest)
+                    fixed = True
+                    action = f"quarantined to {os.path.basename(dest)}"
+                except OSError:
+                    pass
+            if fixed:
+                restored = journal_docs.get(name_tid)
+                if restored is not None:
+                    from ..parallel.file_trials import _write_doc
+
+                    try:
+                        doc, draw = restored
+                        _write_doc(path, doc)
+                        docs_by_tid[int(doc["tid"])] = doc
+                        seen_states[int(doc["tid"])] = doc["state"]
+                        result = journal_results.get(int(doc["tid"]))
+                        if result is not None:
+                            doc = dict(doc)
+                            doc["result"] = result
+                            doc["state"] = (
+                                JOB_STATE_ERROR
+                                if result.get("status") == STATUS_FAIL
+                                else JOB_STATE_DONE
+                            )
+                            _write_doc(path, doc)
+                            seen_states[int(doc["tid"])] = doc["state"]
+                        action += "; restored from response journal"
+                    except OSError:
+                        action += "; journal restore FAILED"
+            report.add(
+                "FS401", path, f"corrupt trial doc ({e})",
+                repaired=fixed, action=action,
+            )
+            continue
+        report.n_docs += 1
+        tid = int(doc.get("tid", -1))
+        if name_tid is None or tid != name_tid:
+            # FS404: the doc claims a tid its filename does not carry —
+            # two files can then answer for one tid
+            fixed = False
+            action = ""
+            if repair:
+                try:
+                    dest = quarantine_path(path)
+                    os.replace(path, dest)
+                    fixed = True
+                    action = f"quarantined to {os.path.basename(dest)}"
+                except OSError:
+                    pass
+            report.add(
+                "FS404", path,
+                f"doc tid {tid} does not match filename tid {name_tid}",
+                repaired=fixed, action=action,
+            )
+            continue
+        if tid in docs_by_tid:
+            report.add(
+                "FS404", path, f"duplicate tid {tid}", repaired=False
+            )
+            continue
+        docs_by_tid[tid] = doc
+        seen_states[tid] = doc["state"]
+        max_doc_draw = max(
+            max_doc_draw, int(doc.get("misc", {}).get("service_draw", 0))
+        )
+
+    # -- leases (FS402) ---------------------------------------------------
+    for path in sorted(glob.glob(os.path.join(qdir, "leases", "*.lease"))):
+        tid = _tid_from_name(path, ".lease")
+        state = seen_states.get(tid)
+        if tid is not None and state == JOB_STATE_RUNNING:
+            continue
+        detail = (
+            "lease without a trial doc" if state is None
+            else f"lease for non-RUNNING doc (state {state})"
+        )
+        fixed = False
+        if repair:
+            try:
+                os.unlink(path)
+                fixed = True
+            except OSError:
+                pass
+        report.add("FS402", path, detail, repaired=fixed,
+                   action="deleted" if fixed else "")
+
+    # -- locks (FS403) ----------------------------------------------------
+    for path in sorted(glob.glob(os.path.join(qdir, "locks", "*.lock"))):
+        tid = _tid_from_name(path, ".lock")
+        state = seen_states.get(tid)
+        if tid is not None and state in _LOCKABLE_STATES:
+            continue
+        detail = (
+            "lock without a trial doc"
+            if state is None or tid is None
+            else f"lock on a doc that cannot hold one (state {state})"
+        )
+        fixed = False
+        if repair:
+            try:
+                os.unlink(path)
+                fixed = True
+            except OSError:
+                pass
+        report.add("FS403", path, detail, repaired=fixed,
+                   action="deleted" if fixed else "")
+
+    # -- tmp droppings (FS406) --------------------------------------------
+    for sub in ("trials", "locks", "leases", "attachments"):
+        for path in sorted(glob.glob(os.path.join(qdir, sub, "*.tmp.*"))):
+            fixed = False
+            if repair:
+                try:
+                    os.unlink(path)
+                    fixed = True
+                except OSError:
+                    pass
+            report.add(
+                "FS406", path,
+                "tmp dropping from a writer killed mid-atomic-write",
+                repaired=fixed, action="deleted" if fixed else "",
+            )
+
+    # -- id allocator (FS408) ---------------------------------------------
+    counter_lock = os.path.join(qdir, "ids.counter.lock")
+    if os.path.exists(counter_lock):
+        # offline there is no legitimate holder: an allocator died
+        # inside its critical section and every later allocation would
+        # spin to its 30s timeout forever
+        fixed = False
+        if repair:
+            try:
+                os.unlink(counter_lock)
+                fixed = True
+            except OSError:
+                pass
+        report.add(
+            "FS408", counter_lock,
+            "stuck id-counter lock (allocator killed mid-allocation)",
+            repaired=fixed, action="deleted" if fixed else "",
+        )
+    counter_file = os.path.join(qdir, "ids.counter")
+    if docs_by_tid and os.path.exists(counter_file):
+        try:
+            with open(counter_file) as f:
+                counter = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            counter = 0
+        max_tid = max(docs_by_tid)
+        if counter <= max_tid:
+            fixed = False
+            if repair:
+                from ..parallel.file_trials import _atomic_write
+
+                try:
+                    _atomic_write(counter_file, str(max_tid + 1).encode())
+                    fixed = True
+                except OSError:
+                    pass
+            report.add(
+                "FS408", counter_file,
+                f"id counter {counter} at or below highest tid "
+                f"{max_tid}: the next allocation would duplicate a tid",
+                repaired=fixed,
+                action=(f"advanced counter {counter} -> {max_tid + 1}"
+                        if fixed else ""),
+            )
+
+    # -- seed cursor (FS405) ----------------------------------------------
+    from ..service.core import SEED_CURSOR_ATTACHMENT
+
+    cursor_file = _attachment_path(qdir, SEED_CURSOR_ATTACHMENT)
+    evidenced = max(max_doc_draw, max_journal_draw)
+    if evidenced:
+        cursor = 0
+        try:
+            with open(cursor_file) as f:
+                cursor = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            cursor = 0
+        if cursor < evidenced:
+            fixed = False
+            if repair:
+                from ..parallel.file_trials import _atomic_write
+
+                try:
+                    _atomic_write(cursor_file, str(evidenced).encode())
+                    fixed = True
+                except OSError:
+                    pass
+            report.add(
+                "FS405", cursor_file,
+                f"seed cursor {cursor} behind evidenced draw position "
+                f"{evidenced}: a restart would re-issue a used seed",
+                repaired=fixed,
+                action=(f"advanced cursor {cursor} -> {evidenced}"
+                        if fixed else ""),
+            )
+
+    return report
+
+
+def fsck_service_root(root, repair=False) -> FsckReport:
+    """fsck every study queue under an optimization-service root."""
+    root = os.path.abspath(root)
+    report = FsckReport(root=root, repair=repair)
+    studies_dir = os.path.join(root, "studies")
+    if not os.path.isdir(studies_dir):
+        return report
+    for name in sorted(os.listdir(studies_dir)):
+        qdir = os.path.join(studies_dir, name)
+        if os.path.isdir(qdir):
+            fsck_queue(qdir, repair=repair, report=report)
+    return report
+
+
+def fsck_path(path, repair=False) -> FsckReport:
+    """fsck a service root (has ``studies/``) or a single queue dir
+    (has ``trials/``) — detected by layout."""
+    path = os.path.abspath(path)
+    if os.path.isdir(os.path.join(path, "studies")):
+        return fsck_service_root(path, repair=repair)
+    return fsck_queue(path, repair=repair)
+
+
+def main(argv=None) -> int:
+    """CLI body for ``python -m hyperopt_tpu.service fsck``."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m hyperopt_tpu.service fsck",
+        description="Check (and repair) a durable trial store: torn "
+                    "docs, orphan leases/locks, duplicate tids, stale "
+                    "seed cursors, tmp droppings, torn journals.",
+    )
+    ap.add_argument("root", help="service root or single queue directory")
+    ap.add_argument(
+        "--repair", action="store_true",
+        help="apply repairs (default: dry-run report only)",
+    )
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the report as JSON")
+    args = ap.parse_args(argv)
+    report = fsck_path(args.root, repair=args.repair)
+    if args.as_json:
+        print(json.dumps(report.summary(), indent=1))
+    else:
+        print(report.format())
+    return 0 if report.clean else 1

@@ -254,6 +254,15 @@ class LeaseReaper:
             with self._state_lock:
                 self._n_stale_locks += 1
             logger.info("cleared stale lock for trial %s", tid)
+        # tmp-dropping GC: `*.tmp.*` files from a writer killed between
+        # open and os.replace in _atomic_write.  Age-gated by the lease
+        # TTL so an in-flight write is never yanked out from under its
+        # writer.
+        n_tmp = self.jobs.gc_tmp_droppings()
+        if n_tmp:
+            if self.stats is not None:
+                self.stats.record("tmp_dropping_cleared", n_tmp)
+            logger.info("cleared %d torn tmp file(s)", n_tmp)
         return n
 
     # -- thread lifecycle ----------------------------------------------

@@ -22,16 +22,24 @@ Quick start::
                     {"x": hp.uniform("x", -5, 5)}, max_evals=100)
 """
 
-from .client import ServiceClient, ServiceClientError
+from ..resilience.retry import CircuitOpenError
+from .client import (
+    ServiceClient,
+    ServiceClientError,
+    ServiceTransportError,
+    parse_retry_after,
+)
 from .core import (
     BackpressureError,
     OptimizationService,
+    ResponseJournal,
     ServiceDraining,
     Study,
     StudyExists,
     StudyNotFound,
     StudyRegistry,
     SuggestScheduler,
+    canonical_json,
     decode_space,
     encode_space,
 )
@@ -39,17 +47,22 @@ from .server import ServiceServer, free_port
 
 __all__ = [
     "BackpressureError",
+    "CircuitOpenError",
     "OptimizationService",
+    "ResponseJournal",
     "ServiceClient",
     "ServiceClientError",
     "ServiceDraining",
     "ServiceServer",
+    "ServiceTransportError",
     "Study",
     "StudyExists",
     "StudyNotFound",
     "StudyRegistry",
     "SuggestScheduler",
+    "canonical_json",
     "decode_space",
     "encode_space",
     "free_port",
+    "parse_retry_after",
 ]

@@ -3,7 +3,17 @@
 Serves until SIGTERM/SIGINT, then drains gracefully: new suggests are
 rejected with 503, admitted ones complete, study state is already
 write-through on disk, and the process exits 0.  Re-running with the
-same ``--root`` recovers every study.
+same ``--root`` recovers every study — including after ``kill -9``: the
+startup fsck repairs torn docs/journals and the response-journal replay
+restores any commit the crash interrupted.
+
+Subcommand::
+
+    python -m hyperopt_tpu.service fsck <root> [--repair] [--json]
+
+checks (dry-run by default) a service root or single queue directory
+for crash damage; see ``hyperopt_tpu.resilience.fsck`` for the rule
+catalog.
 """
 
 from __future__ import annotations
@@ -64,10 +74,22 @@ def make_parser():
     p.add_argument("--max-studies", type=int, default=DEFAULT_MAX_STUDIES,
                    dest="max_studies")
     p.add_argument("--log-level", default="INFO", dest="log_level")
+    p.add_argument(
+        "--chaos-config", default=None, dest="chaos_config",
+        help="TESTING ONLY: JSON ChaosConfig activating seeded "
+             "service-plane fault injection (torn writes, connection "
+             "resets) inside this server — the chaos-serve campaign's "
+             "hook",
+    )
     return p
 
 
 def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "fsck":
+        from ..resilience.fsck import main as fsck_main
+
+        return fsck_main(argv[1:])
     options = make_parser().parse_args(argv)
     logging.basicConfig(level=getattr(
         logging, options.log_level.upper(), logging.INFO))
@@ -108,6 +130,20 @@ def main(argv=None):
     except ValueError:  # not on the main thread (embedded use)
         pass
 
+    if options.chaos_config:
+        from ..resilience.chaos import ChaosConfig, ChaosMonkey, active
+
+        monkey = ChaosMonkey(
+            ChaosConfig.from_json(options.chaos_config),
+            stats=service.fault_stats,
+        )
+        logger.warning("chaos-serve fault injection ACTIVE (testing)")
+        try:
+            with active(monkey):
+                server.serve_forever()
+        except KeyboardInterrupt:
+            server.stop()
+        return 0
     try:
         server.serve_forever()
     except KeyboardInterrupt:

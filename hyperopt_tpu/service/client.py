@@ -1,10 +1,26 @@
 """ServiceClient — the library side of the optimization service.
 
-Wraps the localhost HTTP API in typed calls, honors the service's
-backpressure contract (429/503 + ``Retry-After`` are retried with the
-server-suggested wait, bounded by ``retry_timeout``), and offers a
-``minimize`` convenience loop that drives suggest → evaluate → report —
-the client-side analog of ``fmin``.
+Wraps the localhost HTTP API in typed calls and makes them **safe to
+retry automatically**:
+
+- every mutating call (``create_study``/``suggest``/``report``) carries
+  a client-generated idempotency key, so a connection reset or timeout
+  mid-request can be retried blindly — the server either never saw the
+  request (retry executes it) or journaled it (retry replays the
+  byte-identical response, consuming nothing);
+- transport failures (connection reset/refused, timeout, a torn
+  response) retry with exponential backoff and **deterministic** jitter
+  (a pure function of ``(retry_seed, route, attempt)`` — campaign runs
+  sleep the same schedule), bounded by ``max_transport_retries`` and a
+  per-call ``deadline``;
+- a trip-after-N :class:`~hyperopt_tpu.resilience.retry.CircuitBreaker`
+  stops hammering a dead server: after ``breaker_threshold``
+  consecutive transport failures calls wait for the half-open probe (or
+  fail fast with :class:`CircuitOpenError` when the deadline cannot
+  cover the cooldown);
+- the service's backpressure contract is still honored: 429/503 +
+  ``Retry-After`` (parsed tolerantly — a malformed header falls back to
+  a default instead of raising) are retried within ``retry_timeout``.
 
 Stdlib only (``urllib``), one connection per call: correctness over
 micro-latency, and the server's ThreadingHTTPServer handles it fine at
@@ -13,14 +29,23 @@ service scale.
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
+import threading
 import time
 import urllib.error
 import urllib.parse
 import urllib.request
+import uuid
 
 from ..base import STATUS_FAIL, STATUS_OK
+from ..resilience.retry import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryPolicy,
+    backoff_delay,
+)
 from .core import BackpressureError, encode_space
 
 logger = logging.getLogger(__name__)
@@ -33,6 +58,19 @@ def _quote(study_id) -> str:
     return urllib.parse.quote(str(study_id), safe="")
 
 
+def parse_retry_after(value, default=0.05) -> float:
+    """Tolerant ``Retry-After`` parse: absent, non-numeric, or negative
+    values fall back to ``default`` instead of raising out of the retry
+    loop (the header may legally be an HTTP-date, or garbage)."""
+    try:
+        seconds = float(value)
+    except (TypeError, ValueError):
+        return float(default)
+    if seconds < 0.0:
+        return float(default)
+    return seconds
+
+
 class ServiceClientError(Exception):
     """A non-retryable error response from the service."""
 
@@ -43,46 +81,136 @@ class ServiceClientError(Exception):
         self.detail = detail
 
 
+class ServiceTransportError(Exception):
+    """The transport kept failing (reset/refused/timeout) past the retry
+    budget — the request may or may not have executed server-side; with
+    an idempotency key, re-issuing it later is still safe."""
+
+    def __init__(self, msg, attempts=0, last_error=None):
+        super().__init__(msg)
+        self.attempts = attempts
+        self.last_error = last_error
+
+
+# transport-level failures that are safe to retry when the request is
+# idempotent.  HTTPError (a served error response) is caught BEFORE this
+# tuple — the server answering is a transport success.
+_TRANSPORT_ERRORS = (OSError, http.client.HTTPException)
+
+
 class ServiceClient:
-    def __init__(self, base_url, timeout=180.0, retry_timeout=30.0):
+    def __init__(self, base_url, timeout=180.0, retry_timeout=30.0,
+                 deadline=120.0, max_transport_retries=8,
+                 backoff_base=0.05, backoff_multiplier=2.0,
+                 backoff_max=2.0, jitter=0.2, retry_seed=0,
+                 breaker_threshold=8, breaker_cooldown=1.0,
+                 idempotency_prefix=None, use_idempotency_keys=True):
         self.base_url = base_url.rstrip("/")
         self.timeout = float(timeout)
         # total wall-clock budget for retrying 429/503 rejections before
         # surfacing BackpressureError to the caller; 0 disables retries
         self.retry_timeout = float(retry_timeout)
+        # per-call wall-clock budget for TRANSPORT retries (resets,
+        # refused connections, timeouts); generous by default so a
+        # client rides through a server kill -9 + restart
+        self.deadline = float(deadline)
+        self.max_transport_retries = int(max_transport_retries)
+        # backoff schedule is deterministic in (retry_seed, route,
+        # attempt) — see resilience.retry.backoff_delay
+        self._retry_policy = RetryPolicy(
+            backoff_base=float(backoff_base),
+            backoff_multiplier=float(backoff_multiplier),
+            backoff_max=float(backoff_max),
+            jitter=float(jitter),
+            seed=int(retry_seed),
+        )
+        self.breaker = CircuitBreaker(
+            threshold=breaker_threshold, cooldown=breaker_cooldown
+        )
+        self.use_idempotency_keys = bool(use_idempotency_keys)
+        self._key_lock = threading.Lock()
+        self._key_seq = 0  # guarded-by: _key_lock
+        self._key_prefix = (
+            idempotency_prefix
+            if idempotency_prefix is not None
+            else uuid.uuid4().hex[:12]
+        )
+
+    def _next_key(self):
+        """One fresh idempotency key per LOGICAL call — reused verbatim
+        across that call's transport retries, never across calls."""
+        if not self.use_idempotency_keys:
+            return None
+        with self._key_lock:
+            self._key_seq += 1
+            seq = self._key_seq
+        return f"{self._key_prefix}-{seq}"
 
     # -- transport -----------------------------------------------------
-    def _request(self, method, path, body=None):
-        deadline = time.monotonic() + self.retry_timeout
+    def _request(self, method, path, body=None, retryable=None, raw=False):
+        if retryable is None:
+            # GETs are safe by definition; mutating routes are safe iff
+            # they carry an idempotency key (the server replays instead
+            # of re-executing); shutdown is idempotent by nature
+            retryable = (
+                method == "GET"
+                or path == "/v1/shutdown"
+                or (isinstance(body, dict)
+                    and body.get("idempotency_key") is not None)
+            )
+        call_deadline = time.monotonic() + self.deadline
+        bp_deadline = time.monotonic() + self.retry_timeout
+        data = None
+        headers = {}
+        if body is not None:
+            data = json.dumps(body).encode()
+            headers["Content-Type"] = "application/json"
+        attempts = 0
         while True:
-            data = None
-            headers = {}
-            if body is not None:
-                data = json.dumps(body).encode()
-                headers["Content-Type"] = "application/json"
+            wait = self.breaker.before_request()
+            if wait > 0.0:
+                if (
+                    not retryable
+                    or time.monotonic() + wait > call_deadline
+                ):
+                    raise CircuitOpenError(
+                        f"circuit open for {self.base_url} "
+                        f"(retry in {wait:.2f}s)",
+                        retry_in=wait,
+                    )
+                time.sleep(wait)
+                continue
             req = urllib.request.Request(
                 self.base_url + path, data=data, headers=headers,
                 method=method,
             )
             try:
                 with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    raw_body = r.read()
+                    self.breaker.record_success()
+                    if raw:
+                        return r.status, raw_body
                     ctype = r.headers.get("Content-Type", "")
-                    raw = r.read()
                     if ctype.startswith("application/json"):
-                        return json.loads(raw.decode())
-                    return raw.decode()
+                        return json.loads(raw_body.decode())
+                    return raw_body.decode()
             except urllib.error.HTTPError as e:
-                raw = e.read()
+                # the server answered: the transport (and breaker) are
+                # fine, whatever the status says
+                self.breaker.record_success()
+                raw_body = e.read()
                 try:
-                    payload = json.loads(raw.decode())
+                    payload = json.loads(raw_body.decode())
                 except (json.JSONDecodeError, UnicodeDecodeError):
-                    payload = {"error": "HTTPError", "detail": raw.decode(
-                        "utf-8", "replace")}
+                    payload = {
+                        "error": "HTTPError",
+                        "detail": raw_body.decode("utf-8", "replace"),
+                    }
                 if e.code in (429, 503):
-                    retry_after = float(
-                        e.headers.get("Retry-After") or 0.05
+                    retry_after = parse_retry_after(
+                        e.headers.get("Retry-After")
                     )
-                    if time.monotonic() + retry_after < deadline:
+                    if time.monotonic() + retry_after < bp_deadline:
                         time.sleep(retry_after)
                         continue
                     raise BackpressureError(
@@ -91,13 +219,73 @@ class ServiceClient:
                 raise ServiceClientError(
                     e.code, payload.get("error"), payload.get("detail")
                 )
+            except _TRANSPORT_ERRORS as e:
+                self.breaker.record_failure()
+                attempts += 1
+                if not retryable:
+                    raise ServiceTransportError(
+                        f"{method} {path} failed in transport "
+                        f"(not retryable): {e!r}",
+                        attempts=attempts, last_error=e,
+                    ) from e
+                delay = backoff_delay(
+                    self._retry_policy, attempts, key=path
+                )
+                if (
+                    attempts > self.max_transport_retries
+                    or time.monotonic() + delay > call_deadline
+                ):
+                    raise ServiceTransportError(
+                        f"{method} {path} failed after {attempts} "
+                        f"transport attempt(s): {e!r}",
+                        attempts=attempts, last_error=e,
+                    ) from e
+                logger.debug(
+                    "transport retry %d for %s %s in %.3fs: %r",
+                    attempts, method, path, delay, e,
+                )
+                time.sleep(delay)
 
     # -- API -----------------------------------------------------------
     def healthz(self) -> bool:
         return bool(self._request("GET", "/healthz").get("ok"))
 
+    def readyz(self) -> dict:
+        """The readiness document, whatever the status code — a
+        not-ready server answers 503 with the SAME document, so this is
+        a single un-retried probe (callers poll via :meth:`wait_ready`),
+        not a call routed through the retry/backpressure machinery."""
+        req = urllib.request.Request(
+            self.base_url + "/readyz", method="GET"
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                return json.loads(e.read().decode())
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                return {"ready": False, "error": f"HTTP {e.code}"}
+
+    def wait_ready(self, timeout=60.0, poll=0.25) -> dict:
+        """Poll ``/readyz`` until green (or raise TimeoutError) —
+        transport errors (server still starting / mid-restart) count as
+        not-ready and keep polling."""
+        deadline = time.monotonic() + float(timeout)
+        last = None
+        while time.monotonic() < deadline:
+            try:
+                last = self.readyz()
+                if last.get("ready"):
+                    return last
+            except _TRANSPORT_ERRORS:
+                pass
+            time.sleep(poll)
+        raise TimeoutError(f"service not ready after {timeout}s: {last}")
+
     def create_study(self, study_id, space, seed=0, algo="tpe",
-                     algo_params=None, exist_ok=False) -> dict:
+                     algo_params=None, exist_ok=False,
+                     idempotency_key=None) -> dict:
         return self._request("POST", "/v1/studies", {
             "study_id": study_id,
             "space_b64": encode_space(space),
@@ -105,18 +293,36 @@ class ServiceClient:
             "algo": algo,
             "algo_params": algo_params or {},
             "exist_ok": bool(exist_ok),
+            "idempotency_key": (
+                idempotency_key if idempotency_key is not None
+                else self._next_key()
+            ),
         })
 
-    def suggest(self, study_id, n=1) -> list:
+    def suggest(self, study_id, n=1, idempotency_key=None) -> list:
         """[{"tid": int, "vals": {label: value}}, ...]"""
         out = self._request(
-            "POST", f"/v1/studies/{_quote(study_id)}/suggest", {"n": int(n)}
+            "POST", f"/v1/studies/{_quote(study_id)}/suggest",
+            {
+                "n": int(n),
+                "idempotency_key": (
+                    idempotency_key if idempotency_key is not None
+                    else self._next_key()
+                ),
+            },
         )
         return out["trials"]
 
     def report(self, study_id, tid, loss=None, status=STATUS_OK,
-               result=None) -> dict:
-        body = {"tid": int(tid), "status": status}
+               result=None, idempotency_key=None) -> dict:
+        body = {
+            "tid": int(tid),
+            "status": status,
+            "idempotency_key": (
+                idempotency_key if idempotency_key is not None
+                else self._next_key()
+            ),
+        }
         if loss is not None:
             body["loss"] = float(loss)
         if result is not None:

@@ -52,6 +52,7 @@ import os
 import pickle
 import threading
 import time
+import zlib
 from collections import deque
 from functools import partial
 
@@ -76,6 +77,11 @@ logger = logging.getLogger(__name__)
 # store FileTrials already provides); values are JSON bytes
 STUDY_CONFIG_ATTACHMENT = "ServiceStudyConfig"
 SEED_CURSOR_ATTACHMENT = "ServiceSeedCursor"
+# the exactly-once response journal: an append-only JSONL file under the
+# study's attachments directory (written directly, not through the
+# rewrite-whole-blob attachment API — appends must be crash-atomic)
+RESPONSE_JOURNAL_ATTACHMENT = "ServiceResponseJournal.jsonl"
+JOURNAL_MAX_ENTRIES = 512
 
 DEFAULT_BATCH_WINDOW = 0.004
 DEFAULT_MAX_BATCH = 32
@@ -84,6 +90,21 @@ DEFAULT_MAX_STUDIES = 256
 DEFAULT_SUGGEST_TIMEOUT = 120.0
 
 _ALGOS = ("tpe", "rand", "anneal")
+
+
+def _active_chaos():
+    """The process-wide chaos monkey (zero import cost when the harness
+    was never loaded) — one definition, in parallel.file_trials."""
+    from ..parallel.file_trials import _active_chaos as impl
+
+    return impl()
+
+
+def canonical_json(payload) -> bytes:
+    """THE response encoding for idempotent routes: a replayed request
+    must return byte-identical bytes, so both the original send and the
+    replay serialize through this one function."""
+    return json.dumps(payload, sort_keys=True).encode()
 
 
 class ServiceError(Exception):
@@ -199,6 +220,204 @@ def _resolve_algo(algo_name: str, algo_params: dict):
     return algo, prep
 
 
+def _journal_codec():
+    """(dumps-default, loads-object-hook) shared with the trial-doc
+    store, so journaled docs round-trip datetimes/bytes identically."""
+    from ..parallel.file_trials import _json_default, _json_object_hook
+
+    return _json_default, _json_object_hook
+
+
+class ResponseJournal:
+    """Bounded, crash-consistent idempotency journal for one study.
+
+    Exactly-once over an unreliable transport needs a durable record of
+    "this request already happened, and THIS is what we answered": a
+    retried ``suggest``/``report``/``create_study`` carrying the same
+    client-generated idempotency key returns the journaled response
+    byte-for-byte — no second seed draw, no second trial, no
+    double-landed loss.
+
+    The journal doubles as a **write-ahead log**: a ``suggest`` entry
+    carries the full suggested docs and its seed draw position, and is
+    appended (fsync'd) BEFORE the docs are inserted into the store.  A
+    crash between the two is repaired at startup by
+    :meth:`Study.replay_journal` (re-insert the docs, advance the seed
+    cursor); a crash before the append loses nothing the client ever
+    saw — its retry re-draws the same cursor position and gets the same
+    suggestion.
+
+    On-disk format: append-only JSONL, every record written as ONE
+    ``O_APPEND`` write of ``\\n<crc32 hex> <json>`` — a torn append
+    (power loss mid-write) garbles at most the record being written,
+    which by construction was never acknowledged to a client; the next
+    append's leading newline re-synchronizes the reader.  Bounded by
+    ``max_entries`` (oldest evicted; retried requests arrive within
+    seconds, not thousands of requests later) and compacted in place
+    once the file accumulates 4x that in appends.
+    """
+
+    # lock-order: _lock
+    def __init__(self, path=None, max_entries=JOURNAL_MAX_ENTRIES):
+        self.path = path
+        self.max_entries = int(max_entries)
+        self._lock = threading.Lock()
+        self._entries = {}  # guarded-by: _lock  (key -> entry dict)
+        self._order = deque()  # guarded-by: _lock  (keys, oldest first)
+        self._seq = 0  # guarded-by: _lock
+        self._appends_since_compact = 0  # guarded-by: _lock
+        self.n_torn_lines = 0  # from the last load; read-only after init
+        if self.path:
+            self._load()
+
+    # -- codec ---------------------------------------------------------
+    def _format_record(self, entry) -> bytes:
+        default, _ = _journal_codec()
+        body = json.dumps(entry, default=default, sort_keys=True).encode()
+        return b"\n%08x %s" % (zlib.crc32(body) & 0xFFFFFFFF, body)
+
+    @staticmethod
+    def parse_lines(raw: bytes):
+        """(entries, n_torn) from raw journal bytes.  Lines that fail
+        their CRC or do not parse count as torn and are skipped — only
+        an unacknowledged tail record can legitimately be torn."""
+        _, object_hook = _journal_codec()
+        entries, torn = [], 0
+        for line in raw.split(b"\n"):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                crc_hex, body = line.split(b" ", 1)
+                if (zlib.crc32(body) & 0xFFFFFFFF) != int(crc_hex, 16):
+                    raise ValueError("crc mismatch")
+                entries.append(
+                    json.loads(body.decode(), object_hook=object_hook)
+                )
+            except (ValueError, json.JSONDecodeError, UnicodeDecodeError):
+                torn += 1
+        return entries, torn
+
+    def _load(self):
+        try:
+            with open(self.path, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return
+        entries, self.n_torn_lines = self.parse_lines(raw)
+        entries.sort(key=lambda e: int(e.get("seq", 0)))
+        with self._lock:
+            for entry in entries[-self.max_entries:]:
+                key = entry["key"]
+                if key not in self._entries:
+                    self._order.append(key)
+                self._entries[key] = entry
+                self._seq = max(self._seq, int(entry.get("seq", 0)))
+
+    def _append_line(self, entry):
+        line = self._format_record(entry)
+        fd = os.open(self.path, os.O_CREAT | os.O_WRONLY | os.O_APPEND,
+                     0o644)
+        try:
+            os.write(fd, line)
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    # -- API -------------------------------------------------------------
+    def get(self, key):
+        """The journaled entry for ``key`` (None = never seen)."""
+        if key is None:
+            return None
+        with self._lock:
+            return self._entries.get(str(key))
+
+    def payload(self, key, kind=None):
+        """The journaled response payload for ``key`` decoded from its
+        canonical bytes (None = never seen).  ``kind`` guards against a
+        key reused across ROUTES: a report's payload must never replay
+        as a suggest response (wrong shape, served as a 200)."""
+        entry = self.get(key)
+        if entry is None:
+            return None
+        if kind is not None and entry.get("kind") != kind:
+            raise ValueError(
+                f"idempotency key {key!r} was used for a "
+                f"{entry.get('kind')!r} request; refusing to replay it "
+                f"as {kind!r} — use a fresh key per logical request"
+            )
+        return json.loads(base64.b64decode(entry["payload_b64"]))
+
+    def record(self, key, kind, payload_bytes: bytes, docs=None,
+               draw_index=None, tid=None, result=None):
+        """Journal one response (durably, when the study is durable)
+        BEFORE its side effects land in the trial store."""
+        with self._lock:
+            self._seq += 1
+            entry = {
+                "seq": self._seq,
+                "key": str(key),
+                "kind": str(kind),
+                "payload_b64": base64.b64encode(payload_bytes).decode(
+                    "ascii"
+                ),
+            }
+            if docs is not None:
+                entry["docs"] = docs
+                entry["draw_index"] = int(draw_index)
+            if tid is not None:
+                entry["tid"] = int(tid)
+                entry["result"] = result
+            if str(key) not in self._entries:
+                self._order.append(str(key))
+            self._entries[str(key)] = entry
+            while len(self._order) > self.max_entries:
+                evicted = self._order.popleft()
+                self._entries.pop(evicted, None)
+            if self.path:
+                self._append_line(entry)
+                self._appends_since_compact += 1
+                if self._appends_since_compact > 4 * self.max_entries:
+                    # compaction: rewrite with only the live entries
+                    # (atomic replace — crash-safe at any point)
+                    from ..parallel.file_trials import _atomic_write
+
+                    blob = b"".join(
+                        self._format_record(self._entries[k])
+                        for k in self._order
+                    )
+                    _atomic_write(self.path, blob)
+                    self._appends_since_compact = 0
+        if self.path:
+            chaos = _active_chaos()
+            if chaos is not None:
+                chaos.maybe_torn_journal(self.path, str(key))
+        return entry
+
+    def entries(self):
+        """Live entries, oldest first (a snapshot)."""
+        with self._lock:
+            return [self._entries[k] for k in self._order]
+
+    def __len__(self):
+        with self._lock:
+            return len(self._order)
+
+
+def suggest_payload(docs) -> list:
+    """The suggest response body for a list of suggested trial docs —
+    shared by the live path, the journal, and replays."""
+    out = []
+    for doc in docs:
+        vals = {
+            label: v[0]
+            for label, v in doc["misc"]["vals"].items()
+            if len(v)
+        }
+        out.append({"tid": int(doc["tid"]), "vals": vals})
+    return out
+
+
 class Study:
     """One tenant of the optimization service.
 
@@ -236,6 +455,20 @@ class Study:
         self._docs_by_tid = {}
         for doc in self.trials._dynamic_trials:
             self._docs_by_tid[int(doc["tid"])] = doc
+        # exactly-once plumbing, both touched only under self.lock:
+        # the response journal (durable for FileTrials-backed studies)
+        # and the in-flight dedup map (a retried key whose original
+        # request is still queued attaches to the SAME pending instead
+        # of consuming a second seed)
+        self.journal = ResponseJournal(path=self._journal_path())
+        self._inflight = {}  # idempotency_key -> _PendingSuggest
+
+    def _journal_path(self):
+        if getattr(self.trials, "jobs", None) is None:
+            return None
+        return self.trials.jobs.attachment_path(
+            RESPONSE_JOURNAL_ATTACHMENT
+        )
 
     # -- durability ----------------------------------------------------
     @property
@@ -322,6 +555,13 @@ class Study:
             self.trials.refresh()
 
     def insert(self, docs, draw_index=None):
+        if draw_index is not None:
+            for doc in docs:
+                # the draw position travels WITH the doc so fsck and
+                # restart recovery can re-derive the seed cursor from
+                # the store alone (a stale cursor attachment is
+                # repairable, not fatal)
+                doc.setdefault("misc", {})["service_draw"] = int(draw_index)
         self.trials.insert_trial_docs(docs)
         # insert SONifies (copies) the docs — index the STORED copies,
         # or report would mutate orphans the history never sees
@@ -337,9 +577,29 @@ class Study:
             )
             self._persist_seed_cursor()
 
-    def report(self, tid, loss=None, status=STATUS_OK, result=None):
-        """Land one trial's outcome: DONE with a result (or ERROR for a
-        failed evaluation), written through to the durable store."""
+    def commit_suggest(self, docs, draw_index, idempotency_key=None):
+        """The suggest commit point (caller holds ``self.lock``): journal
+        first (the WAL — response + docs + draw position, fsync'd), then
+        insert into the store.  A crash between the two is repaired by
+        :meth:`replay_journal`; a crash before the append recovers to
+        "seed never consumed".  Returns the response payload."""
+        payload = None
+        if draw_index is not None:
+            for doc in docs:
+                doc.setdefault("misc", {})["service_draw"] = int(draw_index)
+        payload = suggest_payload(docs)
+        if idempotency_key is not None:
+            self.journal.record(
+                idempotency_key, "suggest", canonical_json(payload),
+                docs=docs, draw_index=draw_index,
+            )
+        self.insert(docs, draw_index=draw_index)
+        return payload
+
+    def _validate_result(self, tid, loss=None, status=STATUS_OK,
+                         result=None):
+        """(doc, result) after full validation — no side effects, so a
+        rejected report never lands in the journal or the store."""
         doc = self._docs_by_tid.get(int(tid))
         if doc is None:
             raise StudyNotFound(
@@ -359,6 +619,9 @@ class Study:
                 f"non-finite loss {result['loss']!r} for trial {tid}; "
                 f"report status='fail' instead"
             )
+        return doc, result
+
+    def _apply_result(self, doc, result):
         doc["result"] = result
         doc["state"] = (
             JOB_STATE_ERROR if result.get("status") == STATUS_FAIL
@@ -369,6 +632,69 @@ class Study:
             self.trials.jobs.write(doc)
         self.refresh_local()
         return doc
+
+    def report(self, tid, loss=None, status=STATUS_OK, result=None,
+               idempotency_key=None):
+        """Land one trial's outcome: DONE with a result (or ERROR for a
+        failed evaluation), written through to the durable store.  With
+        an idempotency key the response is journaled BEFORE the doc
+        mutation (replay re-applies an unlanded result)."""
+        doc, result = self._validate_result(
+            tid, loss=loss, status=status, result=result
+        )
+        if idempotency_key is not None:
+            state = (
+                JOB_STATE_ERROR if result.get("status") == STATUS_FAIL
+                else JOB_STATE_DONE
+            )
+            payload = {"tid": int(doc["tid"]), "state": state}
+            self.journal.record(
+                idempotency_key, "report", canonical_json(payload),
+                tid=int(doc["tid"]), result=result,
+            )
+        return self._apply_result(doc, result)
+
+    # -- startup recovery ------------------------------------------------
+    def max_service_draw(self) -> int:
+        """Highest seed-draw position evidenced by the store or the
+        journal — the floor any recovered seed cursor must respect."""
+        high = 0
+        for doc in self.trials._dynamic_trials:
+            high = max(high, int(doc.get("misc", {}).get(
+                "service_draw", 0
+            )))
+        for entry in self.journal.entries():
+            if entry.get("kind") == "suggest":
+                high = max(high, int(entry.get("draw_index", 0)))
+        return high
+
+    def replay_journal(self) -> int:
+        """Re-apply journal entries whose effects never landed (the
+        crash-between-journal-and-store window): re-insert missing
+        suggested docs, re-land unapplied reports.  Idempotent; returns
+        the number of entries that needed replaying."""
+        n = 0
+        for entry in self.journal.entries():
+            kind = entry.get("kind")
+            if kind == "suggest":
+                docs = entry.get("docs") or []
+                missing = [
+                    doc for doc in docs
+                    if int(doc["tid"]) not in self._docs_by_tid
+                ]
+                if missing:
+                    self.insert(
+                        missing, draw_index=entry.get("draw_index")
+                    )
+                    n += 1
+            elif kind == "report":
+                doc = self._docs_by_tid.get(int(entry.get("tid", -1)))
+                if doc is not None and doc["state"] in (
+                    JOB_STATE_NEW, JOB_STATE_RUNNING
+                ):
+                    self._apply_result(doc, entry.get("result"))
+                    n += 1
+        return n
 
     def status(self) -> dict:
         counts = {
@@ -426,6 +752,15 @@ class StudyRegistry:
         # restart recovery
         self._create_lock = threading.Lock()
         self._studies = {}  # guarded-by: _studies_lock
+        # startup-recovery accounting, written once before the server
+        # admits traffic and read by /readyz
+        self.recovery_info = {
+            "recovered_studies": 0,
+            "failed_studies": 0,
+            "journal_entries_replayed": 0,
+            "torn_journal_lines": 0,
+            "seed_cursors_repaired": 0,
+        }
         if self.root:
             os.makedirs(os.path.join(self.root, "studies"), exist_ok=True)
             self._recover()
@@ -455,22 +790,40 @@ class StudyRegistry:
                     algo_params=cfg.get("algo_params") or {},
                     trials=trials,
                 )
+                # exactly-once recovery: re-apply journal entries whose
+                # effects never landed (crash between journal append and
+                # store insert), THEN re-verify the seed cursor against
+                # the evidence in docs + journal — a stale cursor would
+                # re-issue a seed an existing trial already used
+                n_replayed = study.replay_journal()
+                self.recovery_info["journal_entries_replayed"] += n_replayed
+                self.recovery_info["torn_journal_lines"] += (
+                    study.journal.n_torn_lines
+                )
                 try:
                     cursor = int(
                         trials.attachments[SEED_CURSOR_ATTACHMENT].decode()
                     )
                 except (KeyError, ValueError):
                     cursor = 0
+                evidenced = study.max_service_draw()
+                if evidenced > cursor:
+                    cursor = evidenced
+                    self.recovery_info["seed_cursors_repaired"] += 1
                 study.fast_forward_seeds(cursor)
+                study._persist_seed_cursor()
             except Exception:
                 logger.exception("could not recover study dir %s", qdir)
+                self.recovery_info["failed_studies"] += 1
                 continue
             with self._studies_lock:
                 self._studies[study.study_id] = study
+            self.recovery_info["recovered_studies"] += 1
             logger.info(
-                "recovered study %r (%d trials, %d suggests served)",
+                "recovered study %r (%d trials, %d suggests served, "
+                "%d journal entries replayed)",
                 study.study_id, len(study.trials._dynamic_trials),
-                study.n_seeds_drawn,
+                study.n_seeds_drawn, n_replayed,
             )
 
     def create(self, study_id, space, seed=0, algo_name="tpe",
@@ -543,28 +896,36 @@ class _PendingSuggest:
     """One queued suggest request: the handler thread waits on ``done_event``
     while the scheduler fills ``docs`` (or ``error``).  ``ids``/``seed``
     are drawn once on the first dispatch attempt and reused by recovery
-    retries — seed transparency across device failures."""
+    retries — seed transparency across device failures.  A request with
+    an ``idempotency_key`` is also the dedup anchor: retries of the same
+    key wait on THIS pending instead of submitting a second one."""
 
     __slots__ = (
-        "study", "n", "ids", "seed", "draw_index", "docs", "error", "done",
-        "done_event", "cancelled", "enqueued_at",
+        "study", "n", "ids", "seed", "draw_index", "docs", "payload",
+        "error", "done", "done_event", "cancelled", "enqueued_at",
+        "idempotency_key",
     )
 
-    def __init__(self, study: Study, n: int):
+    def __init__(self, study: Study, n: int, idempotency_key=None):
         self.study = study
         self.n = int(n)
+        self.idempotency_key = idempotency_key
         self.ids = None
         self.seed = None
         self.draw_index = None
         self.docs = None
+        self.payload = None
         self.error = None
         self.done = False
         self.cancelled = False
         self.done_event = threading.Event()
         self.enqueued_at = time.monotonic()
 
-    def complete(self, docs):
+    def complete(self, docs, payload=None):
         self.docs = docs
+        self.payload = (
+            payload if payload is not None else suggest_payload(docs)
+        )
         self.done = True
         self.done_event.set()
 
@@ -625,8 +986,9 @@ class SuggestScheduler:
         self._thread.start()
 
     # -- submission -----------------------------------------------------
-    def submit(self, study: Study, n: int = 1) -> _PendingSuggest:
-        pending = _PendingSuggest(study, n)
+    def submit(self, study: Study, n: int = 1,
+               idempotency_key=None) -> _PendingSuggest:
+        pending = _PendingSuggest(study, n, idempotency_key=idempotency_key)
         with self._queue_cv:
             if self._draining or self._stopped:
                 raise ServiceDraining("service is draining; not admitting")
@@ -695,7 +1057,29 @@ class SuggestScheduler:
             logger.exception("suggest batch failed")
             for p in batch:
                 if not p.done:
-                    p.fail(e)
+                    self._fail(p, e)
+
+    def _unregister_inflight(self, p: _PendingSuggest):
+        """Drop a finished pending from its study's dedup map (only if
+        it is still the registered attempt for its key).  Never called
+        while holding the study lock."""
+        if p.idempotency_key is None:
+            return
+        study = p.study
+        with study.lock:
+            if study._inflight.get(p.idempotency_key) is p:
+                del study._inflight[p.idempotency_key]
+
+    def _complete(self, p: _PendingSuggest, docs, payload=None):
+        # unregister BEFORE waking the waiters: a retry that lands
+        # after the wake finds the key in the journal (committed by
+        # commit_suggest), never a half-dead inflight entry
+        self._unregister_inflight(p)
+        p.complete(docs, payload=payload)
+
+    def _fail(self, p: _PendingSuggest, error):
+        self._unregister_inflight(p)
+        p.fail(error)
 
     def _attempt(self, batch):
         from ..resilience.device import is_device_error
@@ -708,7 +1092,7 @@ class SuggestScheduler:
                 # the waiter already timed out and nothing was consumed
                 # yet: abandon it cleanly (seed stays in the study's
                 # stream for the client's retry)
-                p.fail(TimeoutError("abandoned after client timeout"))
+                self._fail(p, TimeoutError("abandoned after client timeout"))
                 continue
             study = p.study
             try:
@@ -722,7 +1106,10 @@ class SuggestScheduler:
                         # host-side path (random startup / no prepare
                         # variant): complete inline, no device program
                         docs = study.suggest_inline(p.ids, p.seed)
-                        study.insert(docs, draw_index=p.draw_index)
+                        payload = study.commit_suggest(
+                            docs, p.draw_index,
+                            idempotency_key=p.idempotency_key,
+                        )
             except Exception as e:
                 # multi-tenant isolation: one study's bad prepare must
                 # not fail the other studies coalesced into this batch —
@@ -733,11 +1120,11 @@ class SuggestScheduler:
                 logger.exception(
                     "suggest for study %r failed", study.study_id
                 )
-                p.fail(e)
+                self._fail(p, e)
                 continue
             if prep is None:
                 self.stats.record_inline()
-                p.complete(docs)
+                self._complete(p, docs, payload=payload)
             else:
                 groups.append(prep[0])
                 finishes.append((p, prep[1]))
@@ -754,16 +1141,19 @@ class SuggestScheduler:
             try:
                 with study.lock:
                     docs = finish(o)
-                    study.insert(docs, draw_index=p.draw_index)
+                    payload = study.commit_suggest(
+                        docs, p.draw_index,
+                        idempotency_key=p.idempotency_key,
+                    )
             except Exception as e:
                 if is_device_error(e):
                     raise
                 logger.exception(
                     "finishing suggest for study %r failed", study.study_id
                 )
-                p.fail(e)
+                self._fail(p, e)
                 continue
-            p.complete(docs)
+            self._complete(p, docs, payload=payload)
 
     # -- drain / shutdown ----------------------------------------------
     def drain(self, timeout=60.0):
@@ -804,7 +1194,7 @@ class OptimizationService:
                  max_batch=DEFAULT_MAX_BATCH, max_queue=DEFAULT_MAX_QUEUE,
                  max_studies=DEFAULT_MAX_STUDIES,
                  suggest_timeout=DEFAULT_SUGGEST_TIMEOUT,
-                 fault_stats=None):
+                 fault_stats=None, startup_fsck=True):
         self.stats = ServiceStats()
         self.timings = PhaseTimings()
         self.fault_stats = (
@@ -813,7 +1203,17 @@ class OptimizationService:
         from ..resilience.device import DeviceRecovery
 
         self.device_recovery = DeviceRecovery(stats=self.fault_stats)
+        # startup order is the recovery protocol: fsck the root FIRST
+        # (quarantine torn docs, clear orphan leases/locks/tmp, trim a
+        # torn journal tail), then let the registry rebuild each study
+        # and replay its response journal against the repaired store
+        self.fsck_report = None
+        self._recovery_ok = True
+        if root and startup_fsck:
+            self._run_startup_fsck(root)
         self.registry = StudyRegistry(root, max_studies=max_studies)
+        if self.registry.recovery_info["failed_studies"]:
+            self._recovery_ok = False
         # the gauge must reflect RECOVERED studies too, not just creates
         self.stats.set_n_studies(len(self.registry))
         self.scheduler = SuggestScheduler(
@@ -826,10 +1226,57 @@ class OptimizationService:
         self.suggest_timeout = float(suggest_timeout)
         self.started_at = time.time()
         self._closed = False
+        # readiness: the device-warm probe runs once, on the first
+        # /readyz, under the recovery wrapper (a dead accelerator
+        # degrades to the CPU backend instead of blocking readiness
+        # forever — degraded-but-serving beats never-ready)
+        self._ready_lock = threading.Lock()
+        self._device_state = "cold"  # guarded-by: _ready_lock
+
+    def _run_startup_fsck(self, root):
+        from ..resilience.fsck import fsck_path
+
+        try:
+            report = fsck_path(root, repair=True)
+            self.fsck_report = report.summary()
+            if not report.clean:
+                self._recovery_ok = False
+                logger.error(
+                    "startup fsck left %d unrepaired finding(s)",
+                    report.n_unrepaired,
+                )
+            elif report.findings:
+                logger.warning(
+                    "startup fsck repaired %d finding(s)",
+                    len(report.findings),
+                )
+        except Exception:
+            logger.exception("startup fsck failed")
+            self._recovery_ok = False
+            self.fsck_report = {"error": "fsck crashed; see server log"}
+
+    def _warm_device(self) -> str:
+        """One-time device-warm probe ('warm' | 'fallback' | 'error')."""
+        def probe():
+            import jax
+
+            jax.block_until_ready(jax.numpy.zeros(()))
+
+        try:
+            self.device_recovery.run(probe)
+        except Exception:
+            logger.exception("device warm probe failed")
+            return "error"
+        return (
+            "fallback" if getattr(
+                self.device_recovery, "cpu_fallback_active", False
+            ) else "warm"
+        )
 
     # -- API -----------------------------------------------------------
     def create_study(self, study_id, space, seed=0, algo="tpe",
-                     algo_params=None, exist_ok=False) -> dict:
+                     algo_params=None, exist_ok=False,
+                     idempotency_key=None) -> dict:
         with self.timings.phase("create_study"):
             try:
                 study = self.registry.create(
@@ -841,41 +1288,105 @@ class OptimizationService:
                 # counter operators watch for suggest over-admission
                 self.stats.record_rejection("create_study")
                 raise
+            except StudyExists:
+                if idempotency_key is None:
+                    raise
+                # a RETRIED create (same idempotency key) replays the
+                # journaled response byte-for-byte.  A keyed create hitting
+                # an existing study whose journal misses the key can still
+                # be the retry of a create that crashed BETWEEN persisting
+                # the config and journaling the response — a config match
+                # proves it is the same logical create, so it attaches (a
+                # keyed create is "create exactly this study": idempotent
+                # by content).  Only a config MISMATCH keeps the 409.
+                study = self.registry.get(study_id)
+                with study.lock:
+                    replay = study.journal.payload(
+                        idempotency_key, kind="create_study"
+                    )
+                if replay is not None:
+                    self.stats.record_replay("create_study")
+                    self.stats.record_request("create_study")
+                    return replay
+                if not study.config_matches(space, seed, algo, algo_params):
+                    raise
+        with study.lock:
+            payload = study.status()
+            if idempotency_key is not None:
+                study.journal.record(
+                    idempotency_key, "create_study",
+                    canonical_json(payload),
+                )
         self.stats.record_request("create_study")
         self.stats.set_n_studies(len(self.registry))
-        return study.status()
+        return payload
 
-    def suggest(self, study_id, n=1, timeout=None) -> list:
+    def suggest(self, study_id, n=1, timeout=None,
+                idempotency_key=None) -> list:
         """Block until the batched scheduler serves this request; returns
-        ``[{"tid": int, "vals": {label: value}}, ...]``."""
+        ``[{"tid": int, "vals": {label: value}}, ...]``.
+
+        With an ``idempotency_key``, a replayed request returns the
+        journaled response without consuming a seed or inserting a
+        second trial, and a retry racing its own original attaches to
+        the in-flight request instead of submitting a duplicate."""
         if n < 1:
             raise ValueError("n must be >= 1")
         t0 = time.perf_counter()
         study = self.registry.get(study_id)
-        pending = self.scheduler.submit(study, n)
-        docs = pending.wait(
+        if idempotency_key is not None:
+            with study.lock:
+                replay = study.journal.payload(
+                    idempotency_key, kind="suggest"
+                )
+                if replay is None:
+                    pending = study._inflight.get(idempotency_key)
+                    if (
+                        pending is not None
+                        and pending.cancelled
+                        and pending.ids is None
+                    ):
+                        # its waiter timed out and the scheduler will
+                        # abandon it without consuming anything —
+                        # attaching would inherit that spurious failure.
+                        # Replace it; one with ids drawn still completes
+                        # and journals, so THAT one we do attach to.
+                        pending = None
+                    if pending is None:
+                        pending = self.scheduler.submit(
+                            study, n, idempotency_key=idempotency_key
+                        )
+                        study._inflight[idempotency_key] = pending
+            if replay is not None:
+                self.stats.record_replay("suggest")
+                self.stats.record_request("suggest", study=study_id)
+                return replay
+        else:
+            pending = self.scheduler.submit(study, n)
+        pending.wait(
             self.suggest_timeout if timeout is None else timeout
         )
         dt = time.perf_counter() - t0
         self.stats.record_request("suggest", seconds=dt, study=study_id)
         self.timings.record("suggest", dt)
-        out = []
-        for doc in docs:
-            vals = {
-                label: v[0]
-                for label, v in doc["misc"]["vals"].items()
-                if len(v)
-            }
-            out.append({"tid": int(doc["tid"]), "vals": vals})
-        return out
+        return pending.payload
 
     def report(self, study_id, tid, loss=None, status=STATUS_OK,
-               result=None) -> dict:
+               result=None, idempotency_key=None) -> dict:
         study = self.registry.get(study_id)
         with self.timings.phase("report"):
             with study.lock:
+                if idempotency_key is not None:
+                    replay = study.journal.payload(
+                        idempotency_key, kind="report"
+                    )
+                    if replay is not None:
+                        self.stats.record_replay("report")
+                        self.stats.record_request("report")
+                        return replay
                 doc = study.report(
-                    tid, loss=loss, status=status, result=result
+                    tid, loss=loss, status=status, result=result,
+                    idempotency_key=idempotency_key,
                 )
         self.stats.record_request("report")
         return {"tid": int(doc["tid"]), "state": doc["state"]}
@@ -897,6 +1408,31 @@ class OptimizationService:
             "draining": self._closed,
             "stats": self.stats.summary(),
             "faults": self.fault_stats.summary(),
+            "recovery": dict(self.registry.recovery_info),
+            "fsck": self.fsck_report,
+        }
+
+    def readiness(self) -> dict:
+        """The /readyz document: ready iff the registry recovered every
+        study, the startup fsck left the store clean, and the device
+        answered its warm probe (possibly via the CPU fallback)."""
+        with self._ready_lock:
+            if self._device_state == "cold":
+                self._device_state = self._warm_device()
+            device_state = self._device_state
+        ready = (
+            self._recovery_ok
+            and device_state in ("warm", "fallback")
+            and not self._closed
+        )
+        return {
+            "ready": ready,
+            "draining": self._closed,
+            "recovery_ok": self._recovery_ok,
+            "device": device_state,
+            "studies": len(self.registry),
+            "recovery": dict(self.registry.recovery_info),
+            "fsck": self.fsck_report,
         }
 
     def metrics_text(self) -> str:

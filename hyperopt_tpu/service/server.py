@@ -8,6 +8,11 @@ request the next batch can coalesce.
 API (all JSON unless noted)::
 
     GET  /healthz                         liveness probe
+    GET  /readyz                          readiness probe: 200 iff the
+                                          registry recovered, the startup
+                                          fsck left the store clean, and
+                                          the device answered its warm
+                                          probe (503 otherwise)
     GET  /metrics                         Prometheus text exposition
     GET  /v1/status                       service-wide stats snapshot
     GET  /v1/studies                      {"studies": [id, ...]}
@@ -26,6 +31,15 @@ header (retry is always safe — a rejected request had no side effects);
 a draining server returns **503**; unknown studies **404**; create
 collisions **409**; malformed requests **400**.  Suggest waits are
 bounded by the service's ``suggest_timeout`` and surface as **504**.
+
+Exactly-once contract: the mutating routes (``create``, ``suggest``,
+``report``) accept a client-generated ``idempotency_key`` in the body.
+A retried request with the same key returns the journaled response
+**byte-identical** (these routes serialize through one canonical
+encoder) with no second seed draw, trial insert, or loss landing —
+which is what makes the client's automatic retry of a connection reset
+or timeout safe.  Handler reads are bounded by a socket timeout so a
+slow-loris client ties up one handler thread for at most that long.
 """
 
 from __future__ import annotations
@@ -43,6 +57,8 @@ from .core import (
     ServiceDraining,
     StudyExists,
     StudyNotFound,
+    _active_chaos,
+    canonical_json,
     decode_space,
 )
 
@@ -58,6 +74,10 @@ class _ServiceHTTPServer(ThreadingHTTPServer):
 class _Handler(BaseHTTPRequestHandler):
     server_version = "hyperopt-tpu-service/0.1"
     protocol_version = "HTTP/1.1"
+    # bound every socket read: a slow-loris client that trickles its
+    # request bytes forever holds ONE handler thread for at most this
+    # long before the read times out and the connection is dropped
+    timeout = 30.0
 
     # -- plumbing ------------------------------------------------------
     def log_message(self, fmt, *args):  # route access logs to logging
@@ -122,6 +142,20 @@ class _Handler(BaseHTTPRequestHandler):
     def service(self) -> OptimizationService:
         return self.server.service
 
+    def _chaos_drop(self, route, key, when) -> bool:
+        """Chaos connection-reset site: drop the connection without a
+        response, either before any state change (``pre``) or after the
+        journal+store commit (``post``).  Returns True when it fired —
+        the caller must then send nothing."""
+        monkey = _active_chaos()
+        if monkey is None:
+            return False
+        if not monkey.should_reset_connection(route, key, when):
+            return False
+        logger.info("chaos: dropping connection (%s, %s)", route, when)
+        self.close_connection = True
+        return True
+
     # -- routes --------------------------------------------------------
     def do_GET(self):
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
@@ -129,6 +163,9 @@ class _Handler(BaseHTTPRequestHandler):
         def handle():
             if path == "/healthz":
                 self._send(200, {"ok": True})
+            elif path == "/readyz":
+                ready = self.service.readiness()
+                self._send(200 if ready["ready"] else 503, ready)
             elif path == "/metrics":
                 self._send(
                     200,
@@ -156,32 +193,60 @@ class _Handler(BaseHTTPRequestHandler):
             # read the body FIRST on every route: an unread body left in
             # a keep-alive stream desyncs the next request's parse
             body = self._read_json()
+            # client-generated idempotency key (exactly-once contract);
+            # None keeps the pre-key at-most-once-per-connection behavior
+            idem = body.get("idempotency_key")
+            if idem is not None:
+                idem = str(idem)
             if path == "/v1/studies":
+                study_id = body["study_id"]
+                # chaos rolls key on the idempotency key when present:
+                # per-LOGICAL-request occurrence streams survive server
+                # restarts (the injection-log replay restores hits) and
+                # scale with traffic instead of with (route, study)
+                if self._chaos_drop("create_study", idem or study_id,
+                                    "pre"):
+                    return
                 out = self.service.create_study(
-                    body["study_id"],
+                    study_id,
                     decode_space(body["space_b64"]),
                     seed=int(body.get("seed", 0)),
                     algo=body.get("algo", "tpe"),
                     algo_params=body.get("algo_params") or None,
                     exist_ok=bool(body.get("exist_ok", False)),
+                    idempotency_key=idem,
                 )
-                self._send(200, out)
+                if self._chaos_drop("create_study", idem or study_id, "post"):
+                    return
+                # the canonical encoder: a replayed response must be
+                # byte-identical to the original, so both serialize here
+                self._send(200, canonical_json(out))
             elif path.startswith("/v1/studies/") and path.endswith("/suggest"):
                 study_id = path[len("/v1/studies/"):-len("/suggest")]
+                if self._chaos_drop("suggest", idem or study_id, "pre"):
+                    return
                 trials = self.service.suggest(
-                    study_id, n=int(body.get("n", 1))
+                    study_id, n=int(body.get("n", 1)),
+                    idempotency_key=idem,
                 )
-                self._send(200, {"trials": trials})
+                if self._chaos_drop("suggest", idem or study_id, "post"):
+                    return
+                self._send(200, canonical_json({"trials": trials}))
             elif path.startswith("/v1/studies/") and path.endswith("/report"):
                 study_id = path[len("/v1/studies/"):-len("/report")]
+                if self._chaos_drop("report", idem or study_id, "pre"):
+                    return
                 out = self.service.report(
                     study_id,
                     body["tid"],
                     loss=body.get("loss"),
                     status=body.get("status", STATUS_OK),
                     result=body.get("result"),
+                    idempotency_key=idem,
                 )
-                self._send(200, out)
+                if self._chaos_drop("report", idem or study_id, "post"):
+                    return
+                self._send(200, canonical_json(out))
             elif path == "/v1/shutdown":
                 self._send(200, {"ok": True, "draining": True})
                 # drain + stop off-thread: this handler must finish its

@@ -1,0 +1,580 @@
+"""Service-plane chaos campaign: kill -9 the server, prove exactly-once.
+
+The ISSUE-5 acceptance run: N studies (default 8) drive the HTTP
+optimization server through their full suggest → evaluate → report
+loops while the campaign injects service-plane faults:
+
+- **server SIGKILL** — a supervisor kills -9 the server process at
+  deterministic points (guaranteed kills at fixed progress fractions
+  plus seeded extras) and restarts it on the same root+port, waiting
+  for ``/readyz`` to go green (startup fsck + journal replay + seed
+  cursor re-verification);
+- **connection resets** — the server's chaos hook drops connections
+  before or after the response commit (seeded, per route/study);
+- **torn doc / torn journal writes** — trial docs are truncated in
+  place after their atomic write and the response journal loses its
+  tail, exercising the CRC trailer + fsck + journal-replay repairs;
+- **slow-loris clients** — parked sockets trickling partial requests,
+  bounded by the handler's read timeout.
+
+Clients ride through all of it on the retrying ``ServiceClient``
+(idempotency keys + deterministic backoff + circuit breaker).  The
+campaign then asserts the exactly-once contract end to end:
+
+1. zero lost or duplicated trials (every study: exactly ``--trials``
+   docs, all DONE, distinct tids);
+2. every study's ``vals`` trajectory identical to a fault-free twin
+   run with the same seeds (no chaos, no HTTP);
+3. a final ``fsck`` pass reports the store clean;
+4. replaying a ``suggest``/``report`` with its original idempotency key
+   returns the byte-identical response and provably consumes no seed
+   (the seed-cursor attachment is unchanged).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/chaos_serve_campaign.py \
+        [--studies 8] [--trials 15] [--seed 0] [--kills 3] [--quick] \
+        [--out CHAOS_SERVE.json]
+
+Exit code 0 iff every assertion held.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ALGO_PARAMS = {"n_startup_jobs": 3, "n_EI_candidates": 32}
+
+
+def _space():
+    from hyperopt_tpu import hp
+
+    return {
+        "x": hp.uniform("x", -5, 5),
+        "lr": hp.loguniform("lr", -5, 0),
+        "c": hp.choice("c", ["a", "b", "d"]),
+    }
+
+
+def _objective(point):
+    """Pure function of the point — the chaos run and the fault-free
+    twin must compute identical losses for identical suggestions."""
+    return (
+        (point["x"] - 1.0) ** 2
+        + (np.log(point["lr"]) + 2.0) ** 2
+        + (0.5 if point["c"] == "b" else 0.0)
+    )
+
+
+def _study_seed(seed, idx):
+    return seed * 1000 + idx
+
+
+# ---------------------------------------------------------------------
+# fault-free twin (in-process, no HTTP, no chaos)
+# ---------------------------------------------------------------------
+
+def run_twin(n_studies, n_trials, seed):
+    """Per-study vals trajectories of the uninterrupted run."""
+    from hyperopt_tpu.fmin import space_eval
+    from hyperopt_tpu.service import OptimizationService
+
+    space = _space()
+    svc = OptimizationService(root=None, batch_window=0.001)
+    out = {}
+    try:
+        for i in range(n_studies):
+            sid = f"chaos-{i}"
+            svc.create_study(sid, space, seed=_study_seed(seed, i),
+                             algo="tpe", algo_params=ALGO_PARAMS)
+            traj = []
+            for _ in range(n_trials):
+                (t,) = svc.suggest(sid)
+                traj.append(t["vals"])
+                point = space_eval(space, t["vals"])
+                svc.report(sid, t["tid"], loss=_objective(point))
+            out[sid] = traj
+    finally:
+        svc.close()
+    return out
+
+
+# ---------------------------------------------------------------------
+# server process management
+# ---------------------------------------------------------------------
+
+class ServerSupervisor:
+    """Owns the server subprocess: spawn, SIGKILL, restart, readiness."""
+
+    def __init__(self, root, port, chaos_config_json, log_dir):
+        self.root = root
+        self.port = port
+        self.chaos_config_json = chaos_config_json
+        self.log_dir = log_dir
+        self.proc = None
+        self.n_kills = 0
+        self.n_tear_deaths = 0  # server SIGKILL'd itself mid-torn-write
+        self.n_starts = 0
+        self._lock = threading.Lock()
+
+    def _env(self):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [REPO] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+        )
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        return env
+
+    def start(self, wait_ready_timeout=180.0):
+        from hyperopt_tpu.service import ServiceClient
+
+        with self._lock:
+            self.n_starts += 1
+            log = open(os.path.join(
+                self.log_dir, f"server.{self.n_starts}.log"), "wb")
+            self.proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "hyperopt_tpu.service",
+                    "--root", self.root,
+                    "--port", str(self.port),
+                    "--batch-window", "0.002",
+                    "--chaos-config", self.chaos_config_json,
+                    "--log-level", "INFO",
+                ],
+                env=self._env(), cwd=REPO,
+                stdout=subprocess.DEVNULL, stderr=log,
+            )
+        client = ServiceClient(self.url, timeout=30)
+        ready = client.wait_ready(timeout=wait_ready_timeout)
+        return ready
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}"
+
+    def kill9(self):
+        with self._lock:
+            if self.proc is None or self.proc.poll() is not None:
+                return False
+            self.proc.send_signal(signal.SIGKILL)
+            self.proc.wait(timeout=30)
+            self.n_kills += 1
+        return True
+
+    def ensure_alive(self):
+        """Restart after a chaos tear-kill (the server SIGKILLs itself
+        mid-torn-write).  Returns True when a restart happened."""
+        with self._lock:
+            dead = self.proc is not None and self.proc.poll() is not None
+            if dead:
+                self.n_tear_deaths += 1
+        if dead:
+            self.start()
+        return dead
+
+    def stop(self, timeout=60.0):
+        with self._lock:
+            proc = self.proc
+        if proc is None or proc.poll() is not None:
+            return
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+def slow_loris(host, port, hold_s=5.0):
+    """Park one connection that trickles a partial request: the server
+    must bound it with its read timeout, not hang a batch."""
+    try:
+        s = socket.create_connection((host, port), timeout=5)
+        s.sendall(b"POST /v1/studies/loris/suggest HTTP/1.1\r\nHost: x\r\n")
+        time.sleep(hold_s)
+        s.close()
+        return True
+    except OSError:
+        return False
+
+
+# ---------------------------------------------------------------------
+# the campaign
+# ---------------------------------------------------------------------
+
+def run_campaign(n_studies=8, n_trials=15, seed=0, min_kills=3,
+                 root=None, quick=False):
+    from hyperopt_tpu.fmin import space_eval
+    from hyperopt_tpu.resilience.chaos import ChaosConfig, ChaosMonkey
+    from hyperopt_tpu.resilience.fsck import fsck_path
+    from hyperopt_tpu.service import ServiceClient, free_port
+
+    if quick:
+        n_trials = min(n_trials, 8)
+    space = _space()
+    t0 = time.time()
+
+    twin = run_twin(n_studies, n_trials, seed)
+
+    if root is None:
+        root = tempfile.mkdtemp(prefix="chaos_serve_")
+    os.makedirs(root, exist_ok=True)
+    injection_log = os.path.join(root, "injections.jsonl")
+    server_cfg = ChaosConfig(
+        seed=seed,
+        p_conn_reset_pre=0.06,
+        p_conn_reset_post=0.06,
+        # crash-consistent tears: each hit tears the write AND SIGKILLs
+        # the server mid-write (tear_kills_process default), so every
+        # tear is also an unscheduled server crash — keep them rarer
+        # than the connection resets
+        p_torn_doc=0.012,
+        p_torn_journal=0.012,
+        injection_log=injection_log,
+    )
+    # the campaign-side monkey rolls the supervisor's sites (kills
+    # beyond the guaranteed schedule, slow-loris) — distinct sites, so
+    # sharing the seed with the server monkey keeps both deterministic
+    campaign_monkey = ChaosMonkey(ChaosConfig(
+        seed=seed, p_server_kill=0.02, p_slow_loris=0.02,
+        injection_log=injection_log,
+    ))
+
+    total_trials = n_studies * n_trials
+    # guaranteed SIGKILLs at fixed progress fractions (mid-campaign =
+    # mid-batch under 8 concurrent clients), seeded extras on top
+    kill_ticks = {
+        max(1, (total_trials * (i + 1)) // (min_kills + 1))
+        for i in range(min_kills)
+    }
+
+    supervisor = ServerSupervisor(
+        root, free_port(), server_cfg.to_json(), root
+    )
+    supervisor.start()
+
+    progress = {"done": 0}
+    progress_cv = threading.Condition()
+    errors = []
+    n_loris = 0
+    stop_supervising = threading.Event()
+
+    def client_for(idx):
+        return ServiceClient(
+            supervisor.url,
+            timeout=60,
+            deadline=300.0,
+            max_transport_retries=200,
+            backoff_base=0.05,
+            backoff_max=1.0,
+            jitter=0.2,
+            retry_seed=seed,
+            breaker_threshold=6,
+            breaker_cooldown=0.5,
+            idempotency_prefix=f"study{idx}",
+        )
+
+    def drive(idx):
+        sid = f"chaos-{idx}"
+        try:
+            client = client_for(idx)
+            client.create_study(
+                sid, space, seed=_study_seed(seed, idx),
+                algo="tpe", algo_params=ALGO_PARAMS, exist_ok=True,
+            )
+            for _ in range(n_trials):
+                (t,) = client.suggest(sid)
+                point = space_eval(space, t["vals"])
+                client.report(sid, t["tid"], loss=_objective(point))
+                with progress_cv:
+                    progress["done"] += 1
+                    progress_cv.notify_all()
+        except Exception as e:
+            errors.append(f"{sid}: {e!r}")
+            with progress_cv:
+                progress_cv.notify_all()
+
+    def supervise():
+        nonlocal n_loris
+        seen = 0
+        while not stop_supervising.is_set():
+            with progress_cv:
+                progress_cv.wait(timeout=0.5)
+                done = progress["done"]
+            try:
+                # a torn-write site SIGKILLs the server from inside —
+                # detect the corpse and restart it
+                supervisor.ensure_alive()
+            except Exception as e:  # pragma: no cover
+                errors.append(f"crash restart failed: {e!r}")
+                stop_supervising.set()
+                return
+            while seen < done:
+                seen += 1
+                kill = seen in kill_ticks
+                if not kill and campaign_monkey.should_kill_server(
+                    "extra"
+                ):
+                    kill = True
+                if kill and supervisor.kill9():
+                    try:
+                        supervisor.start()
+                    except Exception as e:  # pragma: no cover
+                        errors.append(f"restart failed: {e!r}")
+                        stop_supervising.set()
+                        return
+                if campaign_monkey.should_slow_loris("tick"):
+                    if slow_loris("127.0.0.1", supervisor.port,
+                                  hold_s=2.0):
+                        n_loris += 1
+
+    threads = [
+        threading.Thread(target=drive, args=(i,), daemon=True)
+        for i in range(n_studies)
+    ]
+    sup_thread = threading.Thread(target=supervise, daemon=True)
+    sup_thread.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=1200)
+    alive = [t for t in threads if t.is_alive()]
+    if alive:
+        errors.append(f"{len(alive)} study clients timed out")
+
+    # -- exactly-once replay probe (on a scratch study; the supervisor
+    # is still watching, so a tear-kill during the probe just restarts)
+    try:
+        replay = _replay_probe(supervisor.url, space, seed, root)
+    except Exception as e:
+        replay = {"ok": False, "error": repr(e)}
+    stop_supervising.set()
+    sup_thread.join(timeout=30)
+
+    # -- graceful stop, then fsck the store -----------------------------
+    supervisor.stop()
+    fsck_repair = fsck_path(root, repair=True).summary()
+    fsck_verify = fsck_path(root, repair=False).summary()
+
+    # -- reconcile ------------------------------------------------------
+    injected = _count_injections(injection_log)
+    injected["server_kill_executed"] = supervisor.n_kills
+    injected["tear_deaths"] = supervisor.n_tear_deaths
+    injected["slow_loris_executed"] = n_loris
+    n_injected = (
+        sum(v for k, v in injected.items()
+            if not k.endswith("_executed") and k != "tear_deaths")
+        + supervisor.n_kills + n_loris
+        - injected.get("server_kill", 0) - injected.get("slow_loris", 0)
+    )
+    total_sigkills = supervisor.n_kills + supervisor.n_tear_deaths
+
+    integrity, trajectories_match = _verify_store(
+        root, twin, n_studies, n_trials
+    )
+
+    ok = (
+        not errors
+        and integrity["lost_trials"] == 0
+        and integrity["duplicated_trials"] == 0
+        and trajectories_match
+        and fsck_verify["clean"]
+        and replay["ok"]
+        and total_sigkills >= min_kills
+    )
+    return {
+        "campaign": "chaos_serve",
+        "ok": ok,
+        "seed": seed,
+        "n_studies": n_studies,
+        "n_trials_per_study": n_trials,
+        "algo_params": ALGO_PARAMS,
+        "elapsed_s": round(time.time() - t0, 2),
+        "errors": errors,
+        "server_kills": total_sigkills,
+        "server_kills_scheduled": supervisor.n_kills,
+        "server_kills_mid_write": supervisor.n_tear_deaths,
+        "server_starts": supervisor.n_starts,
+        "slow_loris_connections": n_loris,
+        "injected": injected,
+        "total_injected": n_injected,
+        "integrity": integrity,
+        "trajectories_match_fault_free": trajectories_match,
+        "fsck_after_repair": {
+            k: v for k, v in fsck_verify.items() if k != "findings"
+        },
+        "fsck_repairs": fsck_repair["by_rule"],
+        "replay": replay,
+        "root": root,
+    }
+
+
+def _count_injections(path):
+    out = {}
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except FileNotFoundError:
+        return out
+    for line in raw.split(b"\n"):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line.decode())
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            continue  # a torn tail line (the server was killed mid-append)
+        site = rec.get("site", "?")
+        out[site] = out.get(site, 0) + 1
+    return out
+
+
+def _replay_probe(url, space, seed, root):
+    """The acceptance's replay check, on a scratch study: same key →
+    byte-identical response, seed cursor file untouched."""
+    from hyperopt_tpu.service import ServiceClient
+    from hyperopt_tpu.service.core import SEED_CURSOR_ATTACHMENT
+
+    client = ServiceClient(url, deadline=120.0)
+    sid = "replaycheck"
+    client.create_study(sid, space, seed=seed + 7, algo="tpe",
+                        algo_params=ALGO_PARAMS, exist_ok=True)
+    body = {"n": 1, "idempotency_key": "probe-suggest"}
+    st1, b1 = client._request(
+        "POST", f"/v1/studies/{sid}/suggest", body, raw=True
+    )
+    cursor_file = os.path.join(
+        root, "studies", sid, "attachments", SEED_CURSOR_ATTACHMENT
+    )
+    with open(cursor_file, "rb") as f:
+        cursor_before = f.read()
+    st2, b2 = client._request(
+        "POST", f"/v1/studies/{sid}/suggest", body, raw=True
+    )
+    with open(cursor_file, "rb") as f:
+        cursor_after = f.read()
+    tid = json.loads(b1.decode())["trials"][0]["tid"]
+    rbody = {"tid": tid, "loss": 1.25, "idempotency_key": "probe-report"}
+    rs1, rb1 = client._request(
+        "POST", f"/v1/studies/{sid}/report", rbody, raw=True
+    )
+    rbody2 = dict(rbody, loss=99.0)  # a buggy retry with a mutated loss
+    rs2, rb2 = client._request(
+        "POST", f"/v1/studies/{sid}/report", rbody2, raw=True
+    )
+    status = client.study_status(sid)
+    ok = (
+        st1 == st2 == rs1 == rs2 == 200
+        and b1 == b2
+        and rb1 == rb2
+        and cursor_before == cursor_after
+        and status["n_trials"] == 1
+        and status["best"]["loss"] == 1.25
+    )
+    return {
+        "ok": ok,
+        "suggest_bytes_identical": b1 == b2,
+        "report_bytes_identical": rb1 == rb2,
+        "seed_cursor_unchanged": cursor_before == cursor_after,
+        "first_loss_stands": status.get("best", {}).get("loss") == 1.25,
+    }
+
+
+def _verify_store(root, twin, n_studies, n_trials):
+    """Read every study's docs off disk (post-fsck) and check the
+    zero-lost/zero-duplicated and trajectory-identity invariants."""
+    from hyperopt_tpu.base import JOB_STATE_DONE
+    from hyperopt_tpu.parallel.file_trials import FileTrials
+
+    lost = dup = incomplete = 0
+    mismatched = []
+    for i in range(n_studies):
+        sid = f"chaos-{i}"
+        qdir = os.path.join(root, "studies", sid)
+        trials = FileTrials(qdir)
+        docs = sorted(
+            trials._dynamic_trials, key=lambda d: int(d["tid"])
+        )
+        tids = [int(d["tid"]) for d in docs]
+        if len(set(tids)) != len(tids):
+            dup += len(tids) - len(set(tids))
+        if len(docs) < n_trials:
+            lost += n_trials - len(docs)
+        if len(docs) > n_trials:
+            dup += len(docs) - n_trials
+        incomplete += sum(
+            1 for d in docs if d["state"] != JOB_STATE_DONE
+        )
+        got = [
+            {
+                label: v[0]
+                for label, v in d["misc"]["vals"].items() if len(v)
+            }
+            for d in docs
+        ]
+        want = twin[sid]
+        if len(got) != len(want) or any(
+            g.keys() != w.keys()
+            or any(not np.isclose(g[k], w[k]) for k in g)
+            for g, w in zip(got, want)
+        ):
+            mismatched.append(sid)
+    return (
+        {
+            "lost_trials": lost,
+            "duplicated_trials": dup,
+            "incomplete_trials": incomplete,
+            "mismatched_studies": mismatched,
+        },
+        not mismatched and incomplete == 0,
+    )
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--studies", type=int, default=8)
+    ap.add_argument("--trials", type=int, default=15)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--kills", type=int, default=3,
+                    help="guaranteed server SIGKILLs (seeded extras on top)")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI smoke config (caps trials per study at 8)")
+    ap.add_argument(
+        "--out",
+        default=os.path.join(REPO, "CHAOS_SERVE.json"),
+    )
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    report = run_campaign(
+        n_studies=args.studies,
+        n_trials=args.trials,
+        seed=args.seed,
+        min_kills=args.kills,
+        quick=args.quick,
+    )
+    print(json.dumps(report, indent=1, default=str))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1, default=str)
+            f.write("\n")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,340 @@
+"""fsck for the durable trial store (hyperopt_tpu.resilience.fsck).
+
+Covers the ISSUE 5 store layer: every rule in the FS401-FS408 catalog
+detects its damage class in dry-run mode and repairs it in repair mode,
+the CRC doc trailer round-trips (legacy docs without one still read),
+torn docs quarantine instead of crashing ``all_docs``, and the service
+root recursion + CLI entry behave.
+"""
+
+import json
+import os
+import zlib
+
+import pytest
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.base import JOB_STATE_DONE, JOB_STATE_NEW
+from hyperopt_tpu.parallel.file_trials import (
+    DocCorrupt,
+    FileTrials,
+    _decode_doc,
+    _encode_doc,
+    _read_doc,
+    _write_doc,
+)
+from hyperopt_tpu.resilience.fsck import fsck_path, fsck_queue, main
+from hyperopt_tpu.service import OptimizationService
+
+SPACE = {"x": hp.uniform("x", -5, 5)}
+
+
+def _mk_queue(tmp_path, n_docs=3):
+    qdir = str(tmp_path / "q")
+    trials = FileTrials(qdir)
+    docs = []
+    for tid in trials.new_trial_ids(n_docs):
+        doc = {
+            "tid": tid, "state": JOB_STATE_NEW, "spec": None,
+            "result": {"status": "new"},
+            "misc": {
+                "tid": tid, "cmd": None, "idxs": {"x": [tid]},
+                "vals": {"x": [0.5]}, "workdir": None,
+            },
+            "exp_key": None, "owner": None, "version": 0,
+            "book_time": None, "refresh_time": None,
+        }
+        trials.insert_trial_docs([doc])
+        docs.append(doc)
+    return qdir, trials, docs
+
+
+# ---------------------------------------------------------------------
+# the CRC trailer itself
+# ---------------------------------------------------------------------
+
+
+class TestDocTrailer:
+    def test_roundtrip(self):
+        doc = {"tid": 1, "state": 0, "misc": {"vals": {"x": [1.5]}}}
+        raw = _encode_doc(doc)
+        assert b"#crc32:" in raw
+        assert _decode_doc(raw) == doc
+
+    def test_legacy_doc_without_trailer_reads(self):
+        raw = json.dumps({"tid": 2, "state": 1}).encode()
+        assert _decode_doc(raw) == {"tid": 2, "state": 1}
+
+    def test_torn_payload_detected(self):
+        raw = _encode_doc({"tid": 1, "state": 0})
+        with pytest.raises(DocCorrupt):
+            _decode_doc(raw[: len(raw) // 2])
+
+    def test_garbled_payload_detected(self):
+        raw = bytearray(_encode_doc({"tid": 1, "state": 0}))
+        raw[3] ^= 0xFF  # flip one payload byte; trailer now mismatches
+        with pytest.raises(DocCorrupt):
+            _decode_doc(bytes(raw))
+
+    def test_read_doc_quarantines_and_all_docs_survives(self, tmp_path):
+        qdir, trials, docs = _mk_queue(tmp_path)
+        victim = trials.jobs.trial_path(docs[1]["tid"])
+        with open(victim, "r+b") as f:
+            f.truncate(os.path.getsize(victim) // 2)
+        got = trials.jobs.all_docs()  # must not raise
+        assert [d["tid"] for d in got] == [0, 2]
+        assert not os.path.exists(victim)
+        assert os.path.exists(victim + ".corrupt")
+
+    def test_crc_matches_payload(self):
+        doc = {"tid": 9, "state": 2}
+        raw = _encode_doc(doc)
+        payload, trailer = raw.rsplit(b"\n#crc32:", 1)
+        crc_hex, length = trailer.rstrip(b"\n").split(b":")
+        assert int(length) == len(payload)
+        assert int(crc_hex, 16) == zlib.crc32(payload) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------
+# rule catalog
+# ---------------------------------------------------------------------
+
+
+class TestRules:
+    def test_fs401_torn_doc_quarantined(self, tmp_path):
+        qdir, trials, docs = _mk_queue(tmp_path)
+        victim = trials.jobs.trial_path(docs[0]["tid"])
+        with open(victim, "r+b") as f:
+            f.truncate(os.path.getsize(victim) // 2)
+        report = fsck_queue(qdir, repair=False)
+        assert report.by_rule().get("FS401") == 1
+        assert not report.clean
+        assert os.path.exists(victim)  # dry run touched nothing
+        report = fsck_queue(qdir, repair=True)
+        assert report.clean
+        assert not os.path.exists(victim)
+        assert fsck_queue(qdir, repair=False).clean
+
+    def test_fs402_orphan_lease(self, tmp_path):
+        qdir, trials, docs = _mk_queue(tmp_path)
+        trials.jobs.grant_lease(docs[0]["tid"], "nobody")  # doc is NEW
+        trials.jobs.grant_lease(999, "ghost")  # no doc at all
+        report = fsck_queue(qdir, repair=False)
+        assert report.by_rule().get("FS402") == 2
+        fsck_queue(qdir, repair=True)
+        assert trials.jobs.read_lease(docs[0]["tid"]) is None
+        assert fsck_queue(qdir, repair=False).clean
+
+    def test_fs403_orphan_lock(self, tmp_path):
+        qdir, trials, docs = _mk_queue(tmp_path)
+        with open(trials.jobs.lock_path(docs[0]["tid"]), "w") as f:
+            f.write("dead-worker")  # doc still NEW: crashed mid-reserve
+        with open(trials.jobs.lock_path(777), "w") as f:
+            f.write("ghost")
+        report = fsck_queue(qdir, repair=False)
+        assert report.by_rule().get("FS403") == 2
+        fsck_queue(qdir, repair=True)
+        assert trials.jobs.locked_tids() == []
+        assert fsck_queue(qdir, repair=False).clean
+
+    def test_fs403_running_doc_keeps_lock(self, tmp_path):
+        from hyperopt_tpu.base import JOB_STATE_RUNNING
+
+        qdir, trials, docs = _mk_queue(tmp_path)
+        doc = dict(docs[0])
+        doc["state"] = JOB_STATE_RUNNING
+        trials.jobs.write(doc)
+        with open(trials.jobs.lock_path(doc["tid"]), "w") as f:
+            f.write("live-worker")
+        trials.jobs.grant_lease(doc["tid"], "live-worker")
+        report = fsck_queue(qdir, repair=True)
+        # a RUNNING doc's lock+lease are legitimate — untouched
+        assert report.clean and not report.findings
+        assert trials.jobs.locked_tids() == [doc["tid"]]
+
+    def test_fs404_tid_filename_mismatch(self, tmp_path):
+        qdir, trials, docs = _mk_queue(tmp_path)
+        # duplicate doc 0 under the filename of a new tid
+        src = trials.jobs.trial_path(docs[0]["tid"])
+        dst = trials.jobs.trial_path(42)
+        with open(src, "rb") as f:
+            raw = f.read()
+        with open(dst, "wb") as f:
+            f.write(raw)
+        report = fsck_queue(qdir, repair=False)
+        assert report.by_rule().get("FS404") == 1
+        fsck_queue(qdir, repair=True)
+        assert not os.path.exists(dst)
+        assert os.path.exists(src)
+        assert fsck_queue(qdir, repair=False).clean
+
+    def test_fs406_tmp_droppings(self, tmp_path):
+        qdir, trials, docs = _mk_queue(tmp_path)
+        dropping = os.path.join(
+            qdir, "trials", "000000000000.json.tmp.123.456"
+        )
+        with open(dropping, "w") as f:
+            f.write("{partial")
+        report = fsck_queue(qdir, repair=False)
+        assert report.by_rule().get("FS406") == 1
+        fsck_queue(qdir, repair=True)
+        assert not os.path.exists(dropping)
+
+    def test_fs408_stuck_counter_lock_and_low_counter(self, tmp_path):
+        qdir, trials, docs = _mk_queue(tmp_path)
+        with open(os.path.join(qdir, "ids.counter.lock"), "w"):
+            pass
+        # counter torn back to empty (writer killed mid-write pre-fix)
+        with open(os.path.join(qdir, "ids.counter"), "w"):
+            pass
+        report = fsck_queue(qdir, repair=False)
+        assert report.by_rule().get("FS408") == 2
+        fsck_queue(qdir, repair=True)
+        assert not os.path.exists(os.path.join(qdir, "ids.counter.lock"))
+        with open(os.path.join(qdir, "ids.counter")) as f:
+            assert int(f.read()) == max(d["tid"] for d in docs) + 1
+        assert fsck_queue(qdir, repair=False).clean
+
+
+# ---------------------------------------------------------------------
+# service-level rules: journal restore, seed cursor, torn journal
+# ---------------------------------------------------------------------
+
+
+class TestServiceRules:
+    def _service_study(self, tmp_path, n=2):
+        root = str(tmp_path / "root")
+        svc = OptimizationService(root=root, batch_window=0.001)
+        svc.create_study("s", SPACE, seed=5, algo="rand")
+        tids = []
+        for i in range(n):
+            (t,) = svc.suggest("s", idempotency_key=f"k{i}")
+            svc.report("s", t["tid"], loss=float(i),
+                       idempotency_key=f"r{i}")
+            tids.append(t["tid"])
+        svc.close()
+        return root, os.path.join(root, "studies", "s"), tids
+
+    def test_fs401_restore_from_journal(self, tmp_path):
+        root, qdir, tids = self._service_study(tmp_path)
+        victim = os.path.join(qdir, "trials", f"{tids[0]:012d}.json")
+        with open(victim, "r+b") as f:
+            f.truncate(os.path.getsize(victim) // 2)
+        report = fsck_path(root, repair=True)
+        assert report.by_rule().get("FS401") == 1
+        # restored from the journal, report result included
+        doc = _read_doc(victim)
+        assert doc is not None
+        assert doc["state"] == JOB_STATE_DONE
+        assert doc["result"]["loss"] == 0.0
+        assert fsck_path(root, repair=False).clean
+
+    def test_fs405_stale_seed_cursor(self, tmp_path):
+        from hyperopt_tpu.service.core import SEED_CURSOR_ATTACHMENT
+
+        root, qdir, tids = self._service_study(tmp_path)
+        cursor = os.path.join(qdir, "attachments", SEED_CURSOR_ATTACHMENT)
+        with open(cursor, "w") as f:
+            f.write("0")  # rolled back: restart would re-issue seed 1
+        report = fsck_path(root, repair=False)
+        assert report.by_rule().get("FS405") == 1
+        fsck_path(root, repair=True)
+        with open(cursor) as f:
+            assert int(f.read()) == 2
+        assert fsck_path(root, repair=False).clean
+
+    def test_fs407_torn_journal_tail(self, tmp_path):
+        from hyperopt_tpu.service.core import ResponseJournal
+
+        root, qdir, tids = self._service_study(tmp_path)
+        jpath = os.path.join(
+            qdir, "attachments", "ServiceResponseJournal.jsonl"
+        )
+        size = os.path.getsize(jpath)
+        with open(jpath, "r+b") as f:
+            f.truncate(size - 7)
+        report = fsck_path(root, repair=False)
+        assert report.by_rule().get("FS407") == 1
+        fsck_path(root, repair=True)
+        assert fsck_path(root, repair=False).clean
+        # the surviving records still parse
+        j = ResponseJournal(path=jpath)
+        assert j.n_torn_lines == 0
+        assert len(j) == 3  # k0, r0, k1 survive; r1's tail was torn
+
+    def test_clean_root_is_clean(self, tmp_path):
+        root, qdir, tids = self._service_study(tmp_path)
+        report = fsck_path(root, repair=False)
+        assert report.clean and not report.findings
+        assert report.n_docs == 2
+
+
+# ---------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------
+
+
+class TestCLI:
+    def test_dry_run_exit_codes_and_json(self, tmp_path, capsys):
+        qdir, trials, docs = _mk_queue(tmp_path)
+        assert main([qdir]) == 0
+        victim = trials.jobs.trial_path(docs[0]["tid"])
+        with open(victim, "r+b") as f:
+            f.truncate(3)
+        capsys.readouterr()  # drain the first run's text report
+        assert main([qdir, "--json"]) == 1
+        out = json.loads(capsys.readouterr().out)
+        assert out["clean"] is False
+        assert out["by_rule"].get("FS401") == 1
+        assert main([qdir, "--repair"]) == 0
+        assert main([qdir]) == 0
+
+    def test_module_subcommand(self, tmp_path):
+        # python -m hyperopt_tpu.service fsck <root> routes here
+        from hyperopt_tpu.service.__main__ import main as service_main
+
+        qdir, trials, docs = _mk_queue(tmp_path)
+        assert service_main(["fsck", qdir]) == 0
+
+
+# ---------------------------------------------------------------------
+# tmp-dropping GC satellites (requeue_stale + reaper)
+# ---------------------------------------------------------------------
+
+
+class TestTmpDroppingGC:
+    def _dropping(self, qdir, sub, name, age=120.0):
+        import time as _time
+
+        p = os.path.join(qdir, sub, name) if sub else os.path.join(
+            qdir, name
+        )
+        with open(p, "w") as f:
+            f.write("torn")
+        old = _time.time() - age
+        os.utime(p, (old, old))
+        return p
+
+    def test_requeue_stale_gcs_tmp_droppings(self, tmp_path):
+        qdir, trials, docs = _mk_queue(tmp_path)
+        old = self._dropping(qdir, "trials", "x.json.tmp.1.2")
+        old_root = self._dropping(qdir, None, "ids.counter.tmp.1.2")
+        fresh = self._dropping(
+            qdir, "leases", "y.lease.tmp.3.4", age=0.0
+        )
+        trials.jobs.requeue_stale(30.0)
+        assert not os.path.exists(old)
+        assert not os.path.exists(old_root)
+        assert os.path.exists(fresh)  # young: may be a write in flight
+
+    def test_reaper_gcs_tmp_droppings(self, tmp_path):
+        from hyperopt_tpu.observability import FaultStats
+        from hyperopt_tpu.resilience.leases import LeaseReaper
+
+        qdir, trials, docs = _mk_queue(tmp_path)
+        old = self._dropping(qdir, "attachments", "blob.tmp.9.9")
+        stats = FaultStats()
+        reaper = LeaseReaper(trials, stats=stats)
+        reaper.reap_once()
+        assert not os.path.exists(old)
+        assert stats.get("tmp_dropping_cleared") == 1

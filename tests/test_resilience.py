@@ -850,6 +850,128 @@ def test_fault_stats_merge_and_summary():
 
 
 # ---------------------------------------------------------------------
+# service-plane chaos sites (ISSUE 5)
+# ---------------------------------------------------------------------
+
+
+class TestServicePlaneChaosSites:
+    def _cfg(self, **kw):
+        from hyperopt_tpu.resilience.chaos import ChaosConfig
+
+        base = dict(
+            seed=3, p_conn_reset_pre=0.4, p_conn_reset_post=0.4,
+            p_server_kill=0.3, p_slow_loris=0.5,
+            p_torn_doc=1.0, p_torn_journal=1.0,
+            tear_kills_process=False,  # unit tests must outlive a tear
+        )
+        base.update(kw)
+        return ChaosConfig(**base)
+
+    def test_rolls_are_deterministic_in_seed(self):
+        from hyperopt_tpu.resilience.chaos import ChaosMonkey
+
+        def sequence():
+            m = ChaosMonkey(self._cfg())
+            return (
+                [m.should_reset_connection("suggest", "s", "pre")
+                 for _ in range(10)]
+                + [m.should_reset_connection("report", "s", "post")
+                   for _ in range(10)]
+                + [m.should_kill_server("extra") for _ in range(10)]
+                + [m.should_slow_loris("tick") for _ in range(10)]
+            )
+
+        first, second = sequence(), sequence()
+        assert first == second  # pure fn of (seed, site, key, occurrence)
+        assert any(first) and not all(first)
+        # a different seed gives a different schedule
+        from hyperopt_tpu.resilience.chaos import ChaosMonkey as M
+
+        other = M(self._cfg(seed=4))
+        assert [
+            other.should_reset_connection("suggest", "s", "pre")
+            for _ in range(10)
+        ] != first[:10]
+
+    def test_torn_doc_detected_by_crc(self, tmp_path):
+        from hyperopt_tpu.parallel.file_trials import (
+            _encode_doc,
+            _read_doc,
+        )
+        from hyperopt_tpu.resilience.chaos import ChaosMonkey
+
+        path = str(tmp_path / "000000000007.json")
+        with open(path, "wb") as f:
+            f.write(_encode_doc({"tid": 7, "state": 0}))
+        m = ChaosMonkey(self._cfg())
+        m.maybe_torn_doc(path, 7)
+        assert m.stats.get("chaos_torn_doc") == 1
+        # torn in place: quarantined on read, not parsed as garbage
+        assert _read_doc(path) is None
+        assert not os.path.exists(path)
+        assert os.path.exists(path + ".corrupt")
+
+    def test_torn_journal_loses_only_the_tail(self, tmp_path):
+        from hyperopt_tpu.resilience.chaos import ChaosMonkey
+        from hyperopt_tpu.service.core import ResponseJournal
+
+        path = str(tmp_path / "journal.jsonl")
+        j = ResponseJournal(path=path)
+        j.record("a", "report", b"{}", tid=0, result={"status": "ok"})
+        j.record("b", "report", b"{}", tid=1, result={"status": "ok"})
+        m = ChaosMonkey(self._cfg())
+        m.maybe_torn_journal(path, "b")
+        j2 = ResponseJournal(path=path)
+        assert j2.n_torn_lines == 1
+        assert j2.get("a") is not None  # acknowledged entry survives
+        assert j2.get("b") is None  # only the torn tail record is lost
+
+    def test_injection_log_survives_and_counts(self, tmp_path):
+        from hyperopt_tpu.resilience.chaos import ChaosMonkey
+
+        log = str(tmp_path / "inj.jsonl")
+        m = ChaosMonkey(self._cfg(injection_log=log, p_slow_loris=1.0))
+        for _ in range(3):
+            assert m.should_slow_loris("t")
+        import json as _json
+
+        with open(log) as f:
+            recs = [_json.loads(line) for line in f if line.strip()]
+        assert len(recs) == 3
+        assert {r["site"] for r in recs} == {"slow_loris"}
+        assert [r["occurrence"] for r in recs] == [0, 1, 2]
+
+    def test_config_json_roundtrip(self):
+        from hyperopt_tpu.resilience.chaos import ChaosConfig
+
+        cfg = self._cfg(injection_log="/tmp/x.jsonl")
+        again = ChaosConfig.from_json(cfg.to_json())
+        assert again == cfg
+
+
+class TestCircuitBreakerUnits:
+    def test_reopen_after_failed_probe(self):
+        from hyperopt_tpu.resilience.retry import CircuitBreaker
+
+        clock = [0.0]
+        b = CircuitBreaker(threshold=1, cooldown=5.0,
+                           clock=lambda: clock[0])
+        b.record_failure()
+        assert b.state == "open"
+        clock[0] = 5.1
+        assert b.before_request() == 0.0  # the probe
+        b.record_failure()  # probe failed: re-open from NOW
+        assert b.state == "open"
+        assert b.before_request() == pytest.approx(5.0)
+
+    def test_threshold_validated(self):
+        from hyperopt_tpu.resilience.retry import CircuitBreaker
+
+        with pytest.raises(ValueError):
+            CircuitBreaker(threshold=0)
+
+
+# ---------------------------------------------------------------------
 # race-lint gate for the new locks (satellite)
 # ---------------------------------------------------------------------
 
@@ -858,6 +980,7 @@ def test_resilience_package_passes_race_lint():
 
     paths = [p for p in RACE_LINT_FILES
              if os.sep + "resilience" + os.sep in p]
-    assert len(paths) == 3
+    # leases, device, chaos + (ISSUE 5) retry's client circuit breaker
+    assert len(paths) == 4
     diags = lint_races(paths)
     assert diags == [], [d.format() for d in diags]

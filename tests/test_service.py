@@ -585,8 +585,10 @@ class TestHTTP:
                 client.study_status("missing")
             assert e.value.status == 404
             client.create_study("s", SPACE)
+            # a conflicting config is a 409 (an identical keyed create
+            # would attach — see TestIdempotency)
             with pytest.raises(ServiceClientError) as e:
-                client.create_study("s", SPACE)
+                client.create_study("s", SPACE, seed=99)
             assert e.value.status == 409
             with pytest.raises(ServiceClientError) as e:
                 client._request("POST", "/v1/studies/s/report",
@@ -779,6 +781,509 @@ class TestServiceStats:
         assert summ["rejected"] == {"suggest": 1}
         assert summ["queue_depth"] == 5
         assert summ["n_studies"] == 2
+
+
+class TestIdempotency:
+    """The exactly-once protocol (ISSUE 5): replays are byte-identical
+    and provably consume nothing."""
+
+    def test_suggest_replay_consumes_no_seed(self, tmp_path):
+        from hyperopt_tpu.service.core import SEED_CURSOR_ATTACHMENT
+
+        svc = OptimizationService(root=str(tmp_path / "r"),
+                                  batch_window=0.001)
+        try:
+            svc.create_study("s", SPACE, seed=4, algo_params=AP)
+            p1 = svc.suggest("s", idempotency_key="K")
+            study = svc.registry.get("s")
+            drawn = study.n_seeds_drawn
+            cursor = study.trials.attachments[SEED_CURSOR_ATTACHMENT]
+            p2 = svc.suggest("s", idempotency_key="K")
+            assert p1 == p2
+            assert study.n_seeds_drawn == drawn
+            assert (
+                study.trials.attachments[SEED_CURSOR_ATTACHMENT] == cursor
+            )
+            assert len(study.trials._dynamic_trials) == 1
+            assert svc.stats.summary()["idempotent_replays"] == {
+                "suggest": 1
+            }
+        finally:
+            svc.close()
+
+    def test_report_replay_first_loss_stands(self):
+        svc = OptimizationService(root=None)
+        try:
+            svc.create_study("s", SPACE, seed=0)
+            (t,) = svc.suggest("s")
+            r1 = svc.report("s", t["tid"], loss=1.5, idempotency_key="R")
+            # a buggy retry mutating the loss must NOT double-land
+            r2 = svc.report("s", t["tid"], loss=9.9, idempotency_key="R")
+            assert r1 == r2
+            assert svc.study_status("s")["best"]["loss"] == 1.5
+        finally:
+            svc.close()
+
+    def test_create_replay_and_conflict_semantics(self):
+        svc = OptimizationService(root=None)
+        try:
+            st1 = svc.create_study("s", SPACE, seed=0,
+                                   idempotency_key="C")
+            # same key replays (a retried create)...
+            st2 = svc.create_study("s", SPACE, seed=0,
+                                   idempotency_key="C")
+            assert st1 == st2
+            # ...a new key with the SAME config attaches (covers the
+            # crash window between config persist and journal append —
+            # a keyed create is "create exactly this study")...
+            st3 = svc.create_study("s", SPACE, seed=0,
+                                   idempotency_key="C2")
+            assert st3["study_id"] == "s"
+            # ...and a config MISMATCH is still a hard 409
+            with pytest.raises(StudyExists):
+                svc.create_study("s", SPACE, seed=1,
+                                 idempotency_key="C3")
+            # keyless duplicates keep the strict pre-key contract
+            with pytest.raises(StudyExists):
+                svc.create_study("s", SPACE, seed=0)
+        finally:
+            svc.close()
+
+    def test_concurrent_same_key_attaches_to_inflight(self):
+        svc = OptimizationService(root=None, batch_window=0.05)
+        try:
+            svc.create_study("s", SPACE, seed=0, algo_params=AP)
+            results = []
+
+            def call():
+                results.append(svc.suggest("s", idempotency_key="DUP"))
+
+            threads = [threading.Thread(target=call) for _ in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            study = svc.registry.get("s")
+            # four racing retries of one logical request: ONE trial
+            assert len(study.trials._dynamic_trials) == 1
+            assert all(r == results[0] for r in results)
+            assert study._inflight == {}  # cleaned up after completion
+        finally:
+            svc.close()
+
+    def test_key_reuse_across_routes_is_rejected(self):
+        """A suggest key replayed on the report route must not serve the
+        suggest payload as a 200 report response — wrong shape; it is a
+        client bug surfaced as a 400."""
+        svc = OptimizationService(root=None)
+        try:
+            svc.create_study("s", SPACE, seed=0)
+            (t,) = svc.suggest("s", idempotency_key="X")
+            with pytest.raises(ValueError, match="refusing to replay"):
+                svc.report("s", t["tid"], loss=1.0, idempotency_key="X")
+            # the sane path still lands
+            svc.report("s", t["tid"], loss=1.0, idempotency_key="X-r")
+            assert svc.study_status("s")["n_completed"] == 1
+        finally:
+            svc.close()
+
+    def test_retry_does_not_attach_to_abandoned_pending(self):
+        """A pending whose waiter timed out before it started (cancelled,
+        nothing consumed) will be abandoned by the scheduler — a retry
+        of its key must submit fresh, not inherit the spurious 504."""
+        from hyperopt_tpu.service.core import _PendingSuggest
+
+        svc = OptimizationService(root=None)
+        try:
+            svc.create_study("s", SPACE, seed=0)
+            study = svc.registry.get("s")
+            stale = _PendingSuggest(study, 1, idempotency_key="K")
+            stale.cancelled = True
+            with study.lock:
+                study._inflight["K"] = stale
+            out = svc.suggest("s", idempotency_key="K")
+            assert out and "tid" in out[0]
+        finally:
+            svc.close()
+
+    def test_replay_survives_restart_byte_identical(self, tmp_path):
+        root = str(tmp_path / "r")
+        svc = OptimizationService(root=root, batch_window=0.001)
+        try:
+            svc.create_study("s", SPACE, seed=7, algo_params=AP)
+            p1 = svc.suggest("s", idempotency_key="K")
+            svc.report("s", p1[0]["tid"], loss=2.0, idempotency_key="R")
+        finally:
+            svc.close()
+        svc2 = OptimizationService(root=root, batch_window=0.001)
+        try:
+            assert svc2.suggest("s", idempotency_key="K") == p1
+            study = svc2.registry.get("s")
+            assert len(study.trials._dynamic_trials) == 1
+            assert study.n_seeds_drawn == 1
+        finally:
+            svc2.close()
+
+    def test_journal_wal_crash_window_replayed(self, tmp_path):
+        """A suggest journaled but never inserted (crash between the
+        WAL append and the store insert) is re-applied at startup and
+        the seed cursor advances past its draw."""
+        import copy
+
+        from hyperopt_tpu.service.core import (
+            canonical_json,
+            suggest_payload,
+        )
+
+        root = str(tmp_path / "r")
+        svc = OptimizationService(root=root, batch_window=0.001)
+        svc.create_study("s", SPACE, seed=3, algo="rand")
+        svc.suggest("s", idempotency_key="a")
+        study = svc.registry.get("s")
+        doc = copy.deepcopy(study.trials._dynamic_trials[0])
+        doc["tid"] = doc["misc"]["tid"] = 1
+        doc["misc"]["idxs"] = {k: [1] for k in doc["misc"]["idxs"]}
+        doc["misc"]["service_draw"] = 2
+        payload = suggest_payload([doc])
+        study.journal.record("b", "suggest", canonical_json(payload),
+                             docs=[doc], draw_index=2)
+        svc.close()
+        svc2 = OptimizationService(root=root, batch_window=0.001)
+        try:
+            info = svc2.registry.recovery_info
+            assert info["journal_entries_replayed"] == 1
+            s2 = svc2.registry.get("s")
+            assert len(s2.trials._dynamic_trials) == 2
+            assert s2.n_seeds_drawn == 2
+            assert svc2.suggest("s", idempotency_key="b") == payload
+        finally:
+            svc2.close()
+
+    def test_http_replay_byte_identical(self, tmp_path):
+        with ServiceServer(
+            OptimizationService(root=str(tmp_path / "q"),
+                                batch_window=0.001)
+        ) as server:
+            client = ServiceClient(server.url)
+            client.create_study("s", SPACE, seed=0, algo_params=AP)
+            body = {"n": 1, "idempotency_key": "K"}
+            st1, b1 = client._request(
+                "POST", "/v1/studies/s/suggest", body, raw=True
+            )
+            st2, b2 = client._request(
+                "POST", "/v1/studies/s/suggest", body, raw=True
+            )
+            assert st1 == st2 == 200
+            assert b1 == b2
+
+
+class TestClientRetry:
+    """Transport retries, tolerant Retry-After, circuit breaker."""
+
+    def test_parse_retry_after_tolerates_garbage(self):
+        from hyperopt_tpu.service import parse_retry_after
+
+        assert parse_retry_after("0.5") == 0.5
+        assert parse_retry_after("3") == 3.0
+        default = 0.05
+        for bad in (None, "", "soon", "Wed, 21 Oct 2015 07:28:00 GMT",
+                    "-1"):
+            assert parse_retry_after(bad, default) == default
+
+    def test_malformed_retry_after_does_not_raise(self):
+        """A 429 with a garbage Retry-After header must stay inside the
+        retry loop (the old float(...) raised straight out of it)."""
+        import http.server
+        import socketserver
+
+        hits = []
+
+        class Flaky(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                hits.append(1)
+                if len(hits) < 3:
+                    self.send_response(429)
+                    self.send_header("Retry-After", "not-a-number")
+                    self.send_header("Content-Length", "2")
+                    self.end_headers()
+                    self.wfile.write(b"{}")
+                else:
+                    body = b'{"ok": true}'
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        with socketserver.TCPServer(("127.0.0.1", 0), Flaky) as httpd:
+            threading.Thread(
+                target=httpd.serve_forever, daemon=True
+            ).start()
+            url = f"http://127.0.0.1:{httpd.server_address[1]}"
+            client = ServiceClient(url, retry_timeout=10.0)
+            assert client.healthz() is True
+            httpd.shutdown()
+        assert len(hits) == 3
+
+    def test_get_retries_through_transport_errors(self):
+        """A GET against a server that comes up late succeeds once it
+        does (satellite: GET routes retry on URLError)."""
+        from hyperopt_tpu.service import free_port
+
+        port = free_port()
+        service = OptimizationService(root=None)
+        server_box = {}
+
+        def start_late():
+            time.sleep(1.0)
+            server_box["server"] = ServiceServer(
+                service, port=port
+            ).start()
+
+        threading.Thread(target=start_late, daemon=True).start()
+        try:
+            client = ServiceClient(
+                f"http://127.0.0.1:{port}", deadline=30.0,
+                backoff_base=0.1, breaker_threshold=50,
+            )
+            assert client.healthz() is True
+        finally:
+            time.sleep(0.1)
+            if "server" in server_box:
+                server_box["server"].stop()
+            else:
+                service.close()
+
+    def test_mutating_call_without_key_is_not_transport_retried(self):
+        from hyperopt_tpu.service import ServiceTransportError, free_port
+
+        port = free_port()  # nothing listening
+        client = ServiceClient(
+            f"http://127.0.0.1:{port}", deadline=10.0,
+            use_idempotency_keys=False,
+        )
+        t0 = time.monotonic()
+        with pytest.raises(ServiceTransportError) as e:
+            client.report("s", 0, loss=1.0)
+        assert e.value.attempts == 1  # no blind retry without a key
+        assert time.monotonic() - t0 < 5.0
+
+    def test_circuit_breaker_opens_and_half_opens(self):
+        from hyperopt_tpu.resilience.retry import (
+            CircuitBreaker,
+            CircuitOpenError,
+        )
+
+        clock = [0.0]
+        b = CircuitBreaker(threshold=2, cooldown=10.0,
+                           clock=lambda: clock[0])
+        assert b.before_request() == 0.0
+        b.record_failure()
+        assert b.state == "closed"
+        b.record_failure()
+        assert b.state == "open"
+        assert b.before_request() == pytest.approx(10.0)
+        clock[0] = 10.5  # cooldown elapsed: one probe allowed
+        assert b.state == "half-open"
+        assert b.before_request() == 0.0  # this caller IS the probe
+        assert b.before_request() > 0.0  # concurrent callers wait
+        b.record_success()
+        assert b.state == "closed"
+        assert b.before_request() == 0.0
+        # and CircuitOpenError carries the wait hint
+        err = CircuitOpenError("open", retry_in=2.5)
+        assert err.retry_in == 2.5
+
+    def test_client_fails_fast_when_circuit_open(self):
+        from hyperopt_tpu.resilience.retry import CircuitOpenError
+        from hyperopt_tpu.service import free_port
+
+        port = free_port()  # nothing listening: every dial fails
+        client = ServiceClient(
+            f"http://127.0.0.1:{port}", deadline=3.0,
+            max_transport_retries=50, backoff_base=0.01,
+            backoff_max=0.05, breaker_threshold=3,
+            breaker_cooldown=60.0,
+        )
+        with pytest.raises(CircuitOpenError):
+            client.list_studies()
+
+
+class TestReadyz:
+    def test_readyz_green_on_fresh_server(self, tmp_path):
+        with ServiceServer(
+            OptimizationService(root=str(tmp_path / "q"))
+        ) as server:
+            client = ServiceClient(server.url)
+            ready = client.wait_ready(timeout=60)
+            assert ready["ready"] is True
+            assert ready["recovery_ok"] is True
+            assert ready["device"] in ("warm", "fallback")
+            assert ready["fsck"]["clean"] is True
+
+    def test_startup_fsck_repairs_torn_doc_then_ready(self, tmp_path):
+        root = str(tmp_path / "r")
+        svc = OptimizationService(root=root, batch_window=0.001)
+        svc.create_study("s", SPACE, seed=1, algo="rand")
+        (t,) = svc.suggest("s", idempotency_key="K")
+        svc.report("s", t["tid"], loss=3.0, idempotency_key="R")
+        svc.close()
+        # tear the doc on disk (latent corruption a restart discovers)
+        doc_file = os.path.join(
+            root, "studies", "s", "trials", f"{t['tid']:012d}.json"
+        )
+        with open(doc_file, "r+b") as f:
+            f.truncate(os.path.getsize(doc_file) // 2)
+        svc2 = OptimizationService(root=root, batch_window=0.001)
+        try:
+            ready = svc2.readiness()
+            assert ready["ready"] is True
+            assert ready["fsck"]["by_rule"].get("FS401") == 1
+            # the doc came back from the journal, loss included
+            st = svc2.study_status("s")
+            assert st["n_completed"] == 1
+            assert st["best"]["loss"] == 3.0
+        finally:
+            svc2.close()
+
+    def test_draining_server_is_not_ready(self):
+        svc = OptimizationService(root=None)
+        try:
+            assert svc.readiness()["ready"] is True
+            svc.drain(timeout=5.0)
+            assert svc.readiness()["ready"] is False
+        finally:
+            svc.close()
+
+
+class TestKillMinus9:
+    """ISSUE 5 satellite: the restart suite beyond graceful SIGTERM —
+    kill -9, restart, /readyz green, exact trajectory continues."""
+
+    N_FIRST, N_TOTAL = 4, 10
+
+    def _env(self):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [ROOT] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+        )
+        env["JAX_PLATFORMS"] = "cpu"
+        return env
+
+    def _spawn(self, root, port):
+        return subprocess.Popen(
+            [
+                sys.executable, "-m", "hyperopt_tpu.service",
+                "--root", root, "--port", str(port),
+            ],
+            env=self._env(), cwd=ROOT,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    def _twin_vals(self, seed, n):
+        svc = OptimizationService(root=None, batch_window=0.001)
+        try:
+            svc.create_study("k9", SPACE, seed=seed, algo="rand")
+            out = []
+            for _ in range(n):
+                (t,) = svc.suggest("k9")
+                out.append(t["vals"])
+                svc.report("k9", t["tid"], loss=1.0)
+            return out
+        finally:
+            svc.close()
+
+    def test_kill9_restart_readyz_exact_trajectory(self, tmp_path):
+        from hyperopt_tpu.service import free_port
+
+        twin = self._twin_vals(seed=21, n=self.N_TOTAL)
+        root = str(tmp_path / "svc")
+        port = free_port()
+        proc = self._spawn(root, port)
+        got = []
+        try:
+            client = ServiceClient(
+                f"http://127.0.0.1:{port}", deadline=120.0,
+                max_transport_retries=100, backoff_max=0.5,
+                breaker_threshold=20, breaker_cooldown=0.25,
+            )
+            client.wait_ready(timeout=120)
+            client.create_study("k9", SPACE, seed=21, algo="rand")
+            for _ in range(self.N_FIRST):
+                (t,) = client.suggest("k9")
+                got.append(t["vals"])
+                client.report("k9", t["tid"], loss=1.0)
+            # kill -9: no drain, no flush beyond the write-through
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+            proc = self._spawn(root, port)
+            ready = client.wait_ready(timeout=120)
+            assert ready["ready"] is True
+            assert ready["recovery"]["recovered_studies"] == 1
+            for _ in range(self.N_TOTAL - self.N_FIRST):
+                (t,) = client.suggest("k9")
+                got.append(t["vals"])
+                client.report("k9", t["tid"], loss=1.0)
+            st = client.study_status("k9")
+            assert st["n_completed"] == self.N_TOTAL
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+        assert len(got) == len(twin)
+        for i, (g, w) in enumerate(zip(got, twin)):
+            assert g.keys() == w.keys(), (i, g, w)
+            for k in g:
+                assert np.isclose(g[k], w[k]), (i, k, g, w)
+
+    def test_kill9_with_suggest_in_flight_exactly_once(self, tmp_path):
+        """A suggest mid-flight when the server dies is retried by the
+        client through the restart and lands exactly once."""
+        from hyperopt_tpu.service import free_port
+
+        root = str(tmp_path / "svc")
+        port = free_port()
+        proc = self._spawn(root, port)
+        box = {}
+        try:
+            client = ServiceClient(
+                f"http://127.0.0.1:{port}", deadline=180.0,
+                max_transport_retries=200, backoff_max=0.5,
+                breaker_threshold=20, breaker_cooldown=0.25,
+            )
+            client.wait_ready(timeout=120)
+            client.create_study("k9", SPACE, seed=5, algo="rand")
+            (t0_trial,) = client.suggest("k9")
+            client.report("k9", t0_trial["tid"], loss=1.0)
+
+            def inflight():
+                try:
+                    box["trial"] = client.suggest("k9")
+                except Exception as e:  # pragma: no cover - debug aid
+                    box["error"] = e
+
+            th = threading.Thread(target=inflight, daemon=True)
+            th.start()
+            proc.send_signal(signal.SIGKILL)  # lands around the suggest
+            proc.wait(timeout=30)
+            proc = self._spawn(root, port)
+            client.wait_ready(timeout=120)
+            th.join(timeout=180)
+            assert not th.is_alive()
+            assert "error" not in box, box
+            (t1_trial,) = box["trial"]
+            client.report("k9", t1_trial["tid"], loss=2.0)
+            st = client.study_status("k9")
+            # exactly once: two suggests -> two trials, no orphans
+            assert st["n_trials"] == 2
+            assert st["n_completed"] == 2
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
 
 
 class TestRenderPrometheus:
