@@ -567,10 +567,49 @@ def serve_section(argv):
     return 0 if report["ok"] else 1
 
 
+def trace_section(argv):
+    """``python bench.py --trace [--quick]``: request-tracing smoke — the
+    seeded multi-study loadgen with end-to-end tracing on (sample 1.0),
+    aggregated by scripts/trace_report.py; asserts the tiling phase
+    spans cover >= 90% of every sampled suggest's server wall-time and
+    that every XLA compile event observed carries a (trial-bucket,
+    family) key and the trace id that paid for it.  Prints ONE JSON
+    line like the other bench sections."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    serve_loadgen = _import_script("serve_loadgen")
+    quick = "--quick" in argv
+    t0 = time.time()
+    bench, trep = serve_loadgen.run_traced(
+        n_studies=8, n_trials=6 if quick else 12, seed=0,
+        batch_window=0.004, trace_sample=1.0,
+        overhead_check="--overhead" in argv,
+    )
+    out = {
+        "metric": "trace_smoke",
+        "value": trep["coverage"]["min"],
+        "unit": "min_coverage",
+        "ok": trep["ok"],
+        "n_suggest_traces": trep["n_suggest_traces"],
+        "coverage_mean": trep["coverage"]["mean"],
+        "n_compile_events": trep["compile_events"]["n"],
+        "compiles_attributed": trep["compile_events"]["attributed"],
+        "suggest_p50_ms": trep["suggest_latency"]["p50_ms"],
+        "suggest_p99_ms": trep["suggest_latency"]["p99_ms"],
+        "elapsed_s": round(time.time() - t0, 2),
+    }
+    if "overhead" in trep:
+        out["p50_regression_frac"] = trep["overhead"]["p50_regression_frac"]
+    print(json.dumps(out))
+    return 0 if trep["ok"] else 1
+
+
 def main():
     if "--wallclock" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--wallclock"]
         return wallclock_section(argv)
+    if "--trace" in sys.argv[1:]:
+        argv = [a for a in sys.argv[1:] if a != "--trace"]
+        return trace_section(argv)
     if "--serve" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--serve"]
         return serve_section(argv)

@@ -826,6 +826,24 @@ def _multi_sig(requests):
     )
 
 
+def compile_key(sig, shapes):
+    """``(trial_count_bucket, families)`` of one fused-program trace
+    event, from the ``(sig, shapes)`` a ``_trace_observers`` entry
+    receives.  THE shared attribution key: the RecompilationAuditor's
+    bucket summary and the service's compile-event metric/spans both
+    derive it here, so a compile always lands under the same name.
+
+    The trial-count bucket is the ``[CAPT]`` losses-buffer capacity
+    (positional arg 4 of every family core — the power-of-two history
+    bucket); ``families`` is the ``+``-joined kind list (``cont+idx``…).
+    """
+    capt = 0
+    if shapes and len(shapes[0]) > 4 and len(shapes[0][4][0]) == 1:
+        capt = int(shapes[0][4][0][0])
+    families = "+".join(kind for kind, _ in sig) or "none"
+    return capt, families
+
+
 def _build_multi_run(requests):
     """The traced python callable for one fused multi-family suggest —
     shared by the production jit path and the analyzer's jaxpr export so

@@ -83,6 +83,10 @@ RACE_LINT_FILES = (
     # once response journal carry guards
     os.path.join(_PKG_ROOT, "service", "core.py"),
     os.path.join(_PKG_ROOT, "service", "client.py"),
+    # request tracing: handler threads and the scheduler append spans to
+    # shared Trace objects, and concurrent finishes serialize the log
+    # append — span buffers and log-writer state carry guards
+    os.path.join(_PKG_ROOT, "tracing.py"),
 )
 
 

@@ -354,13 +354,14 @@ class RecompilationAuditor:
         """[(history_capacity_bucket, n_traces)] — the losses buffer is
         the [CAPT] argument shared by every family, so its length is the
         trial-count bucket of the trace."""
+        from ..algos import tpe_device
+
         buckets: Dict[int, int] = {}
         for (sig, shapes), n in self.trace_counts.items():
-            # family arg layout (tpe_device._family_suggest_core): the
-            # losses buffer [CAPT] is positional arg 4 of every family
-            capt = 0
-            if shapes and len(shapes[0]) > 4 and len(shapes[0][4][0]) == 1:
-                capt = shapes[0][4][0][0]
+            # shared attribution key (tpe_device.compile_key): the same
+            # (bucket, families) name the service's compile-event
+            # metric and trace spans use
+            capt, _families = tpe_device.compile_key(sig, shapes)
             buckets[capt] = buckets.get(capt, 0) + n
         return sorted(buckets.items())
 

@@ -253,6 +253,79 @@ class FaultStats:
         )
 
 
+# Fixed histogram bucket upper bounds (seconds) for suggest latency —
+# log-spaced from sub-millisecond device-cache hits out past the worst
+# compile-storm tail BENCH_SERVE.json recorded (26s p99); +Inf implied.
+SUGGEST_DURATION_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+class LatencyHistogram:
+    """A fixed-bucket latency histogram (the Prometheus histogram
+    shape: cumulative ``_bucket{le=...}`` counts + ``_sum``/``_count``).
+
+    Unlike a bounded percentile ring buffer, bucket counts never evict:
+    the exported p99 is the p99 of EVERY observation, not "p99 of the
+    last N" — under load a ring silently narrows its window exactly when
+    the tail matters most.  Quantiles are interpolated within the
+    containing bucket (exact at bucket edges, monotone in between).
+
+    NOT thread-safe on its own; the owner (:class:`ServiceStats`)
+    serializes access under its lock.
+    """
+
+    def __init__(self, buckets=SUGGEST_DURATION_BUCKETS):
+        self.buckets = tuple(float(b) for b in buckets)
+        assert list(self.buckets) == sorted(self.buckets)
+        # counts[i] = observations <= buckets[i]; counts[-1] = +Inf bucket
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.total = 0
+        self.sum_s = 0.0
+
+    def observe(self, seconds: float):
+        s = float(seconds)
+        self.total += 1
+        self.sum_s += s
+        for i, edge in enumerate(self.buckets):
+            if s <= edge:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def quantile(self, q: float):
+        """The q-quantile in seconds (None when empty), linearly
+        interpolated inside the containing bucket.  The +Inf bucket has
+        no upper edge; observations there report the last finite edge
+        (a floor — the true value is at least that)."""
+        if not self.total:
+            return None
+        rank = q * self.total
+        seen = 0.0
+        lo = 0.0
+        for i, edge in enumerate(self.buckets):
+            n = self.counts[i]
+            if seen + n >= rank:
+                if n == 0:
+                    return edge
+                frac = (rank - seen) / n
+                return lo + frac * (edge - lo)
+            seen += n
+            lo = edge
+        return self.buckets[-1] if self.buckets else None
+
+    def to_dict(self) -> dict:
+        """Cumulative bucket counts keyed by upper edge (the Prometheus
+        exposition shape), plus sum/count."""
+        cum, acc = [], 0
+        for i, edge in enumerate(self.buckets):
+            acc += self.counts[i]
+            cum.append((edge, acc))
+        cum.append((float("inf"), acc + self.counts[-1]))
+        return {"buckets": cum, "count": self.total, "sum_s": self.sum_s}
+
+
 class ServiceStats:
     """Request / latency / batch-occupancy accounting for the
     optimization service (:mod:`hyperopt_tpu.service`).
@@ -262,8 +335,15 @@ class ServiceStats:
     served; and for the continuous-batching scheduler, how many fused
     device dispatches ran and how many suggest requests each one
     carried (``mean_batch_occupancy`` — the "requests per device
-    program" number the service exists to push above 1).  Suggest
-    latencies are kept as a bounded sample for p50/p99.
+    program" number the service exists to push above 1).
+
+    Suggest latency lives in a fixed-bucket :class:`LatencyHistogram`
+    (the exported source of truth — no eviction, so p99 means p99 of
+    everything) with per-phase attributed-seconds counters fed by the
+    scheduler, plus a bounded ring sample kept only for the human
+    ``/v1/status`` JSON (its quantiles are "of the last N" and say so).
+    Idempotent replays are tagged and excluded from latency — a journal
+    hit must not fake a fast suggest or mask a slow one.
 
     Thread-safe: HTTP handler threads and the scheduler thread record
     concurrently.
@@ -277,9 +357,17 @@ class ServiceStats:
         self._rejected = defaultdict(int)       # endpoint -> 429s
         self._replayed = defaultdict(int)       # endpoint -> journal hits
         self._study_suggests = defaultdict(int)  # study -> suggests served
-        # ring buffer: a long-lived server's quantiles must track the
-        # CURRENT traffic, not freeze on the first N samples
+        # the exported latency source of truth: fixed buckets, no window
+        self._suggest_hist = LatencyHistogram()
+        # ring buffer: a bounded human-readable sample of RECENT traffic
+        # for /v1/status only (window size is reported alongside)
         self._suggest_latencies = deque(maxlen=int(max_latency_samples))
+        # per-phase attributed seconds (queue_wait/coalesce/prepare/
+        # dispatch/readback/finish/inline), fed by the scheduler
+        self._phase_s = defaultdict(float)
+        self._phase_n = defaultdict(int)
+        # XLA (re)compile events keyed by (trial-bucket, families)
+        self._compile_events = defaultdict(int)
         self._n_dispatches = 0        # fused device programs launched
         self._n_batched = 0           # suggests served through a dispatch
         self._n_inline = 0            # host-side suggests (startup/rand)
@@ -287,13 +375,19 @@ class ServiceStats:
         self._queue_depth = 0         # last-observed scheduler queue depth
         self._n_studies = 0
 
-    def record_request(self, endpoint: str, seconds=None, study=None):
+    def record_request(self, endpoint: str, seconds=None, study=None,
+                       replay=False):
+        """``replay=True`` marks a response served from the idempotency
+        journal: counted as a request, NEVER as a latency observation
+        (journal hits are instant and would dilute the histogram's
+        tail exactly when retries spike)."""
         with self._lock:
             self._requests[endpoint] += 1
-            if endpoint == "suggest":
+            if endpoint == "suggest" and not replay:
                 if study is not None:
                     self._study_suggests[str(study)] += 1
                 if seconds is not None:
+                    self._suggest_hist.observe(float(seconds))
                     self._suggest_latencies.append(float(seconds))
 
     def record_rejection(self, endpoint: str):
@@ -312,6 +406,24 @@ class ServiceStats:
             self._n_dispatches += 1
             self._n_batched += int(n_requests)
             self._dispatch_s += float(seconds)
+
+    def record_phase(self, phase: str, seconds: float, n: int = 1):
+        """Attribute ``seconds`` of suggest wall-time to a named phase
+        (the histogram's per-phase sums — always on, tracing or not)."""
+        with self._lock:
+            self._phase_s[str(phase)] += float(seconds)
+            self._phase_n[str(phase)] += int(n)
+
+    def record_compile(self, bucket, families):
+        """One XLA (re)trace of the fused suggest program, keyed by its
+        (trial-count bucket, family composition)."""
+        with self._lock:
+            self._compile_events[(int(bucket), str(families))] += 1
+
+    @property
+    def n_compile_events(self) -> int:
+        with self._lock:
+            return sum(self._compile_events.values())
 
     def record_inline(self, n: int = 1):
         """Suggests served host-side (random startup) — no device
@@ -335,22 +447,65 @@ class ServiceStats:
             return self._n_batched / self._n_dispatches
 
     def latency_quantiles(self):
-        """{"p50_ms": ..., "p99_ms": ...} over the suggest sample (None
-        values when no suggests were timed yet)."""
+        """{"p50_ms": ..., "p99_ms": ...} over the FULL histogram — the
+        exported source of truth (bucket-interpolated, no eviction)."""
+        with self._lock:
+            p50 = self._suggest_hist.quantile(0.50)
+            p99 = self._suggest_hist.quantile(0.99)
+        return {
+            "p50_ms": round(p50 * 1e3, 3) if p50 is not None else None,
+            "p99_ms": round(p99 * 1e3, 3) if p99 is not None else None,
+        }
+
+    def window_quantiles(self):
+        """Ring-buffer quantiles over the last-N sample — the HUMAN
+        numbers for /v1/status, with the window size spelled out so
+        "p99" can never be silently read as all-time."""
         import numpy as np
 
         with self._lock:
             lat = list(self._suggest_latencies)
+            cap = self._suggest_latencies.maxlen
         if not lat:
-            return {"p50_ms": None, "p99_ms": None}
+            return {"p50_ms": None, "p99_ms": None,
+                    "window": 0, "max_window": cap}
         arr = np.asarray(lat)
         return {
             "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 3),
             "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 3),
+            "window": len(lat),
+            "max_window": cap,
         }
+
+    def phase_summary(self) -> dict:
+        with self._lock:
+            return {
+                phase: {
+                    "total_s": round(self._phase_s[phase], 6),
+                    "count": self._phase_n[phase],
+                }
+                for phase in sorted(self._phase_s)
+            }
+
+    def compile_events(self) -> dict:
+        """{"<bucket>/<families>": count} snapshot."""
+        with self._lock:
+            return {
+                f"{bucket}/{families}": n
+                for (bucket, families), n in sorted(
+                    self._compile_events.items()
+                )
+            }
+
+    def histogram_dict(self) -> dict:
+        with self._lock:
+            return self._suggest_hist.to_dict()
 
     def summary(self) -> dict:
         q = self.latency_quantiles()
+        window = self.window_quantiles()
+        phases = self.phase_summary()
+        compiles = self.compile_events()
         with self._lock:
             occ = (
                 self._n_batched / self._n_dispatches
@@ -371,7 +526,12 @@ class ServiceStats:
                 "dispatch_s": round(self._dispatch_s, 6),
                 "queue_depth": self._queue_depth,
                 "n_studies": self._n_studies,
+                # histogram-derived (all observations ever)
                 "suggest_latency": q,
+                # ring-derived (recent window; human eyes only)
+                "suggest_latency_window": window,
+                "phase_seconds": phases,
+                "compile_events": compiles,
             }
 
     def log_summary(self, level=logging.INFO):
@@ -504,6 +664,38 @@ def render_prometheus(
         head("service_inline_suggests_total",
              "Suggest requests served host-side (startup/random).", "counter")
         sample("service_inline_suggests_total", None, s["n_inline_suggests"])
+        hist = service.histogram_dict()
+        head("service_suggest_duration_seconds",
+             "Suggest latency histogram (fixed buckets, no eviction — "
+             "the exported quantile source of truth).", "histogram")
+        for edge, cum in hist["buckets"]:
+            le = "+Inf" if edge == float("inf") else repr(float(edge))
+            lines.append(
+                f'{namespace}_service_suggest_duration_seconds_bucket'
+                f'{{le="{le}"}} {cum}'
+            )
+        lines.append(
+            f"{namespace}_service_suggest_duration_seconds_sum "
+            f"{_prom_value(hist['sum_s'])}"
+        )
+        lines.append(
+            f"{namespace}_service_suggest_duration_seconds_count "
+            f"{hist['count']}"
+        )
+        head("service_suggest_phase_seconds_total",
+             "Suggest wall-time attributed to a named phase "
+             "(queue_wait/coalesce/draw/prepare/dispatch/readback/"
+             "finish/inline).", "counter")
+        for phase, st in s.get("phase_seconds", {}).items():
+            sample("service_suggest_phase_seconds_total",
+                   {"phase": phase}, st["total_s"])
+        head("compile_events_total",
+             "XLA (re)compiles of the fused suggest program, keyed by "
+             "(trial-count bucket, family composition).", "counter")
+        for key, n in s.get("compile_events", {}).items():
+            bucket, _, families = key.partition("/")
+            sample("compile_events_total",
+                   {"bucket": bucket, "families": families}, n)
         head("service_batch_occupancy",
              "Mean suggest requests per fused device dispatch.", "gauge")
         sample("service_batch_occupancy", None, s["mean_batch_occupancy"])
@@ -512,7 +704,8 @@ def render_prometheus(
         head("service_studies", "Registered studies.", "gauge")
         sample("service_studies", None, s["n_studies"])
         head("service_suggest_latency_ms",
-             "Suggest latency quantiles over a bounded sample.", "gauge")
+             "Suggest latency quantiles derived from the duration "
+             "histogram (kept for dashboard compatibility).", "gauge")
         for q_key, q_name in (("p50_ms", "0.5"), ("p99_ms", "0.99")):
             sample(
                 "service_suggest_latency_ms",

@@ -49,6 +49,7 @@ import zlib
 from collections.abc import MutableMapping
 
 from .. import native as _native
+from .. import tracing
 from ..base import (
     JOB_STATE_DONE,
     JOB_STATE_ERROR,
@@ -274,14 +275,19 @@ class FileJobs:
 
     # -- docs -----------------------------------------------------------
     def insert(self, doc):
-        _write_doc(self.trial_path(doc["tid"]), doc)
+        # tracing.span is a no-op singleton unless the calling thread
+        # has a request trace bound (the optimization service's store
+        # writes do; driver/worker writes normally don't)
+        with tracing.span("store.write_doc", tid=int(doc["tid"])):
+            _write_doc(self.trial_path(doc["tid"]), doc)
         chaos = _active_chaos()
         if chaos is not None:
             chaos.maybe_torn_lock(self, doc["tid"])
             chaos.maybe_torn_doc(self.trial_path(doc["tid"]), doc["tid"])
 
     def write(self, doc):
-        _write_doc(self.trial_path(doc["tid"]), doc)
+        with tracing.span("store.write_doc", tid=int(doc["tid"])):
+            _write_doc(self.trial_path(doc["tid"]), doc)
         chaos = _active_chaos()
         if chaos is not None:
             chaos.maybe_torn_doc(self.trial_path(doc["tid"]), doc["tid"])

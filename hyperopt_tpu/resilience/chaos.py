@@ -83,6 +83,7 @@ from collections import defaultdict
 from dataclasses import dataclass
 
 from ..observability import FaultStats
+from ..tracing import current_trace_id
 from .device import SyntheticDeviceError
 
 logger = logging.getLogger(__name__)
@@ -227,11 +228,20 @@ class ChaosMonkey:
     def _log_injection(self, site, key, occ):
         """Append one injection record to the crash-surviving log.
         ``O_APPEND`` single-write: a SIGKILL mid-append tears at most
-        the final line, which the reader tolerates."""
+        the final line, which the reader tolerates.
+
+        The active request-trace id (if the injecting thread is inside
+        a traced request) is stamped into the record, so a fault in a
+        ``CHAOS_SERVE.json`` campaign can be joined to the exact trace
+        it perturbed — "this p99 outlier ate a torn-journal injection"
+        becomes a log join instead of a guess."""
         if not self.config.injection_log:
             return
         line = json.dumps(
-            {"site": site, "key": str(key), "occurrence": occ},
+            {
+                "site": site, "key": str(key), "occurrence": occ,
+                "trace_id": current_trace_id(),
+            },
             sort_keys=True,
         ) + "\n"
         try:

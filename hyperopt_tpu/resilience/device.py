@@ -29,6 +29,8 @@ from __future__ import annotations
 import logging
 import threading
 
+from .. import tracing
+
 logger = logging.getLogger(__name__)
 
 
@@ -174,6 +176,13 @@ class DeviceRecovery:
                 action = "exhausted"
         if action == "exhausted":
             return False
+        # visible in the request trace that absorbed the failure: the
+        # re-init/fallback wall-time explains an otherwise-unattributed
+        # slow dispatch (no-op outside a traced request)
+        tracing.add_event(
+            "device.recovery", action=action,
+            error=type(exc).__name__,
+        )
         if action == "cpu":
             self._record("cpu_fallback")
             logger.error(
@@ -191,7 +200,8 @@ class DeviceRecovery:
                 self.max_reinits,
                 exc,
             )
-        _reset_device_state()
+        with tracing.span("device.reinit", action=action):
+            _reset_device_state()
         return True
 
     def run(self, fn):

@@ -75,6 +75,21 @@ def make_parser():
                    dest="max_studies")
     p.add_argument("--log-level", default="INFO", dest="log_level")
     p.add_argument(
+        "--trace-sample", type=float, default=0.0, dest="trace_sample",
+        help="fraction of requests to trace end-to-end (0 disables "
+             "tracing entirely — the hot path pays nothing)",
+    )
+    p.add_argument(
+        "--trace-slow-ms", type=float, default=None, dest="trace_slow_ms",
+        help="always write traces whose root exceeds this many "
+             "milliseconds, regardless of --trace-sample (tail rescue)",
+    )
+    p.add_argument(
+        "--trace-log", default=None, dest="trace_log",
+        help="trace log path (default <root>/trace.jsonl when --root "
+             "is set and tracing is enabled)",
+    )
+    p.add_argument(
         "--chaos-config", default=None, dest="chaos_config",
         help="TESTING ONLY: JSON ChaosConfig activating seeded "
              "service-plane fault injection (torn writes, connection "
@@ -103,12 +118,43 @@ def main(argv=None):
             "(pass --unsafe-allow-remote to override)", options.host,
         )
         return 2
+    tracer = None
+    if options.trace_sample > 0.0 or options.trace_slow_ms is not None:
+        import os
+
+        from ..tracing import Tracer
+
+        trace_log = options.trace_log
+        if trace_log is None and options.root:
+            trace_log = os.path.join(options.root, "trace.jsonl")
+        if trace_log is None:
+            # tracing with nowhere to land would silently pay the full
+            # span cost and discard every trace — refuse up front
+            logger.error(
+                "tracing enabled (--trace-sample/--trace-slow-ms) but "
+                "no trace log destination: pass --trace-log PATH or "
+                "--root DIR"
+            )
+            return 2
+        tracer = Tracer(
+            path=trace_log,
+            sample=options.trace_sample,
+            slow_threshold_s=(
+                None if options.trace_slow_ms is None
+                else options.trace_slow_ms / 1e3
+            ),
+        )
+        logger.info(
+            "request tracing on: sample=%.3f slow_ms=%s log=%s",
+            options.trace_sample, options.trace_slow_ms, trace_log,
+        )
     service = OptimizationService(
         root=options.root,
         batch_window=options.batch_window,
         max_batch=options.max_batch,
         max_queue=options.max_queue,
         max_studies=options.max_studies,
+        tracer=tracer,
     )
     server = ServiceServer(service, host=options.host, port=options.port)
     logger.info(

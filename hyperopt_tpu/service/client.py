@@ -39,6 +39,7 @@ import urllib.parse
 import urllib.request
 import uuid
 
+from .. import tracing
 from ..base import STATUS_FAIL, STATUS_OK
 from ..resilience.retry import (
     CircuitBreaker,
@@ -104,7 +105,8 @@ class ServiceClient:
                  backoff_base=0.05, backoff_multiplier=2.0,
                  backoff_max=2.0, jitter=0.2, retry_seed=0,
                  breaker_threshold=8, breaker_cooldown=1.0,
-                 idempotency_prefix=None, use_idempotency_keys=True):
+                 idempotency_prefix=None, use_idempotency_keys=True,
+                 tracer=None, trace_headers=True):
         self.base_url = base_url.rstrip("/")
         self.timeout = float(timeout)
         # total wall-clock budget for retrying 429/503 rejections before
@@ -128,6 +130,13 @@ class ServiceClient:
             threshold=breaker_threshold, cooldown=breaker_cooldown
         )
         self.use_idempotency_keys = bool(use_idempotency_keys)
+        # tracing: every call carries an X-Hyperopt-Trace id (ambient
+        # when the caller already holds a trace, fresh otherwise) so the
+        # server can attribute its side; a local ``tracer`` additionally
+        # records the CLIENT's view — transport attempts, backoff sleeps,
+        # circuit-breaker waits — under the same id
+        self.tracer = tracer
+        self.trace_headers = bool(trace_headers)
         self._key_lock = threading.Lock()
         self._key_seq = 0  # guarded-by: _key_lock
         self._key_prefix = (
@@ -148,6 +157,36 @@ class ServiceClient:
 
     # -- transport -----------------------------------------------------
     def _request(self, method, path, body=None, retryable=None, raw=False):
+        if self.tracer is not None and self.tracer.enabled \
+                and tracing.current_trace() is None:
+            # this client is the trace ROOT: begin one for the logical
+            # call (all transport attempts share it) and write it out
+            trace = self.tracer.begin()
+            try:
+                with tracing.use_trace(trace):
+                    return self._request_traced(
+                        method, path, body=body, retryable=retryable,
+                        raw=raw,
+                    )
+            finally:
+                self.tracer.finish(trace)
+        return self._request_traced(
+            method, path, body=body, retryable=retryable, raw=raw
+        )
+
+    def _request_traced(self, method, path, body=None, retryable=None,
+                        raw=False):
+        with tracing.span(
+            "client.request", method=method, route=path
+        ) as sp:
+            out = self._request_inner(
+                method, path, body=body, retryable=retryable, raw=raw,
+                root_span=sp,
+            )
+        return out
+
+    def _request_inner(self, method, path, body=None, retryable=None,
+                       raw=False, root_span=tracing.NULL_SPAN):
         if retryable is None:
             # GETs are safe by definition; mutating routes are safe iff
             # they carry an idempotency key (the server replays instead
@@ -165,6 +204,15 @@ class ServiceClient:
         if body is not None:
             data = json.dumps(body).encode()
             headers["Content-Type"] = "application/json"
+        # trace-id propagation: reuse the ambient id (ours or an
+        # enclosing caller's) so client- and server-side spans join on
+        # one id; otherwise assign a fresh id so the SERVER can still
+        # trace this call (it echoes the id back in the response)
+        trace_id = tracing.current_trace_id()
+        if trace_id is None and self.trace_headers:
+            trace_id = tracing.new_trace_id()
+        if trace_id is not None:
+            headers[tracing.TRACE_HEADER] = trace_id
         attempts = 0
         while True:
             wait = self.breaker.before_request()
@@ -178,7 +226,8 @@ class ServiceClient:
                         f"(retry in {wait:.2f}s)",
                         retry_in=wait,
                     )
-                time.sleep(wait)
+                with tracing.span("client.breaker_wait", wait_s=wait):
+                    time.sleep(wait)
                 continue
             req = urllib.request.Request(
                 self.base_url + path, data=data, headers=headers,
@@ -188,6 +237,7 @@ class ServiceClient:
                 with urllib.request.urlopen(req, timeout=self.timeout) as r:
                     raw_body = r.read()
                     self.breaker.record_success()
+                    root_span.set_attr("attempts", attempts + 1)
                     if raw:
                         return r.status, raw_body
                     ctype = r.headers.get("Content-Type", "")
@@ -211,7 +261,11 @@ class ServiceClient:
                         e.headers.get("Retry-After")
                     )
                     if time.monotonic() + retry_after < bp_deadline:
-                        time.sleep(retry_after)
+                        with tracing.span(
+                            "client.backpressure_wait",
+                            wait_s=retry_after, status=e.code,
+                        ):
+                            time.sleep(retry_after)
                         continue
                     raise BackpressureError(
                         f"{e.code} from {path}: {payload.get('detail')}"
@@ -244,7 +298,10 @@ class ServiceClient:
                     "transport retry %d for %s %s in %.3fs: %r",
                     attempts, method, path, delay, e,
                 )
-                time.sleep(delay)
+                with tracing.span(
+                    "client.backoff", wait_s=delay, attempt=attempts
+                ):
+                    time.sleep(delay)
 
     # -- API -----------------------------------------------------------
     def healthz(self) -> bool:

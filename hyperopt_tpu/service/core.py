@@ -46,6 +46,7 @@ Guarantees:
 from __future__ import annotations
 
 import base64
+import contextlib
 import json
 import logging
 import os
@@ -58,6 +59,7 @@ from functools import partial
 
 import numpy as np
 
+from .. import tracing
 from ..base import (
     JOB_STATE_DONE,
     JOB_STATE_ERROR,
@@ -316,13 +318,16 @@ class ResponseJournal:
 
     def _append_line(self, entry):
         line = self._format_record(entry)
-        fd = os.open(self.path, os.O_CREAT | os.O_WRONLY | os.O_APPEND,
-                     0o644)
-        try:
-            os.write(fd, line)
-            os.fsync(fd)
-        finally:
-            os.close(fd)
+        # the fsync here is THE durability point of the exactly-once
+        # protocol — and a named phase in every trace that pays it
+        with tracing.span("journal.fsync", n_bytes=len(line)):
+            fd = os.open(self.path, os.O_CREAT | os.O_WRONLY | os.O_APPEND,
+                         0o644)
+            try:
+                os.write(fd, line)
+                os.fsync(fd)
+            finally:
+                os.close(fd)
 
     # -- API -------------------------------------------------------------
     def get(self, key):
@@ -593,7 +598,8 @@ class Study:
                 idempotency_key, "suggest", canonical_json(payload),
                 docs=docs, draw_index=draw_index,
             )
-        self.insert(docs, draw_index=draw_index)
+        with tracing.span("store.insert", n_docs=len(docs)):
+            self.insert(docs, draw_index=draw_index)
         return payload
 
     def _validate_result(self, tid, loss=None, status=STATUS_OK,
@@ -903,7 +909,8 @@ class _PendingSuggest:
     __slots__ = (
         "study", "n", "ids", "seed", "draw_index", "docs", "payload",
         "error", "done", "done_event", "cancelled", "enqueued_at",
-        "idempotency_key",
+        "idempotency_key", "trace", "parent_span", "popped_at", "spanned",
+        "completed_at",
     )
 
     def __init__(self, study: Study, n: int, idempotency_key=None):
@@ -920,6 +927,15 @@ class _PendingSuggest:
         self.cancelled = False
         self.done_event = threading.Event()
         self.enqueued_at = time.monotonic()
+        # the explicit cross-thread trace handoff (handler → scheduler):
+        # the scheduler re-binds this trace around this request's share
+        # of the batch work, nesting under parent_span (the request's
+        # root span).  Both None when the request is untraced.
+        self.trace = None
+        self.parent_span = None
+        self.popped_at = None  # when the scheduler popped this request
+        self.spanned = False   # intake spans recorded (once, not per retry)
+        self.completed_at = None  # when complete()/fail() fired
 
     def complete(self, docs, payload=None):
         self.docs = docs
@@ -927,11 +943,13 @@ class _PendingSuggest:
             payload if payload is not None else suggest_payload(docs)
         )
         self.done = True
+        self.completed_at = time.monotonic()
         self.done_event.set()
 
     def fail(self, error):
         self.error = error
         self.done = True
+        self.completed_at = time.monotonic()
         self.done_event.set()
 
     def wait(self, timeout):
@@ -986,9 +1004,13 @@ class SuggestScheduler:
         self._thread.start()
 
     # -- submission -----------------------------------------------------
-    def submit(self, study: Study, n: int = 1,
-               idempotency_key=None) -> _PendingSuggest:
+    def submit(self, study: Study, n: int = 1, idempotency_key=None,
+               trace=None, parent_span=None) -> _PendingSuggest:
         pending = _PendingSuggest(study, n, idempotency_key=idempotency_key)
+        # attach the trace BEFORE the queue sees the pending: the
+        # scheduler may pop it the instant the lock releases
+        pending.trace = trace
+        pending.parent_span = parent_span if trace is not None else None
         with self._queue_cv:
             if self._draining or self._stopped:
                 raise ServiceDraining("service is draining; not admitting")
@@ -1014,7 +1036,9 @@ class SuggestScheduler:
                 if self._stopped and not self._queue:
                     return
                 while self._queue and len(batch) < self.max_batch:
-                    batch.append(self._queue.popleft())
+                    p = self._queue.popleft()
+                    p.popped_at = time.monotonic()
+                    batch.append(p)
                 self._busy = True
             # batching window: only when the pop found CONCURRENT
             # traffic does the batch stay open briefly for stragglers —
@@ -1033,7 +1057,9 @@ class SuggestScheduler:
                         if not self._queue:
                             self._queue_cv.wait(remaining)
                         while self._queue and len(batch) < self.max_batch:
-                            batch.append(self._queue.popleft())
+                            p = self._queue.popleft()
+                            p.popped_at = time.monotonic()
+                            batch.append(p)
             with self._queue_cv:
                 depth = len(self._queue)
             self.stats.set_queue_depth(depth)
@@ -1081,9 +1107,32 @@ class SuggestScheduler:
         self._unregister_inflight(p)
         p.fail(error)
 
+    def _span_intake(self, p: _PendingSuggest, t_attempt: float):
+        """Record the passive intake intervals for one request — queue
+        wait (submit → pop) and coalesce (pop → batch close) — into the
+        phase stats and, when traced, the request's trace.  Once per
+        request: a device-recovery retry re-runs ``_attempt`` but the
+        request only queued once."""
+        if p.spanned:
+            return
+        p.spanned = True
+        popped = p.popped_at if p.popped_at is not None else t_attempt
+        self.stats.record_phase("queue_wait", popped - p.enqueued_at)
+        self.stats.record_phase("coalesce", t_attempt - popped)
+        if p.trace is None:
+            return
+        p.trace.record_span(
+            "suggest.queue_wait", p.enqueued_at, popped,
+            parent=p.parent_span,
+        )
+        p.trace.record_span(
+            "suggest.coalesce", popped, t_attempt, parent=p.parent_span,
+        )
+
     def _attempt(self, batch):
         from ..resilience.device import is_device_error
 
+        t_attempt = time.monotonic()
         groups, finishes = [], []
         for p in batch:
             if p.done:
@@ -1095,21 +1144,46 @@ class SuggestScheduler:
                 self._fail(p, TimeoutError("abandoned after client timeout"))
                 continue
             study = p.study
+            self._span_intake(p, t_attempt)
+            t_prep0 = time.monotonic()
+            t_draw1 = None
             try:
-                with study.lock:
-                    if p.ids is None:
-                        p.seed = study.draw_seed()
-                        p.draw_index = study.n_seeds_drawn
-                        p.ids = study.trials.new_trial_ids(p.n)
-                    prep = study.prepare(p.ids, p.seed)
-                    if prep is None:
-                        # host-side path (random startup / no prepare
-                        # variant): complete inline, no device program
-                        docs = study.suggest_inline(p.ids, p.seed)
-                        payload = study.commit_suggest(
-                            docs, p.draw_index,
-                            idempotency_key=p.idempotency_key,
+                # explicit cross-thread handoff: this scheduler thread
+                # adopts the request's trace for exactly this request's
+                # share of the work, then unbinds (spans cannot leak
+                # into a batch-mate's trace)
+                with tracing.use_trace(p.trace, parent=p.parent_span):
+                    if p.trace is not None and t_prep0 > t_attempt:
+                        p.trace.record_span(
+                            "batch.peer_wait", t_attempt, t_prep0,
+                            parent=p.parent_span, stage="prepare",
                         )
+                    with study.lock:
+                        if p.ids is None:
+                            p.seed = study.draw_seed()
+                            p.draw_index = study.n_seeds_drawn
+                            p.ids = study.trials.new_trial_ids(p.n)
+                        # study-lock wait + seed draw + trial-id
+                        # allocation (a durable study pays a counter
+                        # fsync here) — milliseconds that were dark
+                        # before this span existed
+                        t_draw1 = time.monotonic()
+                        if p.trace is not None and t_draw1 > t_prep0:
+                            p.trace.record_span(
+                                "suggest.draw", t_prep0, t_draw1,
+                                parent=p.parent_span,
+                            )
+                        with tracing.span("suggest.prepare"):
+                            prep = study.prepare(p.ids, p.seed)
+                        if prep is None:
+                            # host-side path (random startup / no prepare
+                            # variant): complete inline, no device program
+                            with tracing.span("suggest.inline"):
+                                docs = study.suggest_inline(p.ids, p.seed)
+                                payload = study.commit_suggest(
+                                    docs, p.draw_index,
+                                    idempotency_key=p.idempotency_key,
+                                )
             except Exception as e:
                 # multi-tenant isolation: one study's bad prepare must
                 # not fail the other studies coalesced into this batch —
@@ -1122,29 +1196,82 @@ class SuggestScheduler:
                 )
                 self._fail(p, e)
                 continue
+            t_prep1 = time.monotonic()
+            self.stats.record_phase("draw", (t_draw1 or t_prep1) - t_prep0)
             if prep is None:
+                self.stats.record_phase("inline", t_prep1 - (t_draw1 or t_prep0))
                 self.stats.record_inline()
                 self._complete(p, docs, payload=payload)
             else:
+                self.stats.record_phase("prepare", t_prep1 - (t_draw1 or t_prep0))
                 groups.append(prep[0])
-                finishes.append((p, prep[1]))
+                finishes.append((p, prep[1], t_prep1))
         if not finishes:
             return
         t0 = time.perf_counter()
         from ..algos import tpe_device
 
-        resolvers = tpe_device.multi_study_suggest_async(groups)
-        outs = [r() for r in resolvers]  # ONE readback, on the first call
-        self.stats.record_dispatch(len(finishes), time.perf_counter() - t0)
-        for (p, finish), o in zip(finishes, outs):
+        # the batch LEADER's trace is bound for the fused launch: an XLA
+        # retrace fired here (via the tpe_device trace observers) becomes
+        # a compile span on exactly one trace — the one that paid for it
+        lead = next(
+            (p for p, _, _ in finishes if p.trace is not None), None
+        )
+        t_launch0 = time.monotonic()
+        with tracing.use_trace(
+            lead.trace if lead is not None else None,
+            parent=lead.parent_span if lead is not None else None,
+        ):
+            resolvers = tpe_device.multi_study_suggest_async(groups)
+            t_launch1 = time.monotonic()
+            outs = [r() for r in resolvers]  # ONE readback, first call
+        t_read1 = time.monotonic()
+        n_batch = len(finishes)
+        self.stats.record_dispatch(n_batch, time.perf_counter() - t0)
+        self.stats.record_phase("dispatch", t_launch1 - t_launch0)
+        self.stats.record_phase("readback", t_read1 - t_launch1)
+        # fan the shared device spans out to EVERY traced request in the
+        # batch: the span interval is the real (shared) wall interval,
+        # and pro_rata_s attributes this request's 1/n share — summing
+        # pro_rata_s across the batch reproduces the batch total
+        for p, _, t_prep1 in finishes:
+            if p.trace is None:
+                continue
+            if t_launch0 > t_prep1:
+                # time spent behind LATER batch-mates' prepares
+                p.trace.record_span(
+                    "batch.peer_wait", t_prep1, t_launch0,
+                    parent=p.parent_span, stage="prepare",
+                )
+            p.trace.record_span(
+                "device.dispatch", t_launch0, t_launch1,
+                parent=p.parent_span, batch_size=n_batch, shared=True,
+                pro_rata_s=round((t_launch1 - t_launch0) / n_batch, 9),
+            )
+            p.trace.record_span(
+                "device.readback", t_launch1, t_read1,
+                parent=p.parent_span, batch_size=n_batch, shared=True,
+                pro_rata_s=round((t_read1 - t_launch1) / n_batch, 9),
+                device_total_s=round(t_read1 - t_launch0, 9),
+            )
+        for (p, finish, _t_prep1), o in zip(finishes, outs):
             study = p.study
+            t_f0 = time.monotonic()
             try:
-                with study.lock:
-                    docs = finish(o)
-                    payload = study.commit_suggest(
-                        docs, p.draw_index,
-                        idempotency_key=p.idempotency_key,
-                    )
+                with tracing.use_trace(p.trace, parent=p.parent_span):
+                    if p.trace is not None and t_f0 > t_read1:
+                        # time spent behind batch-mates' finishes
+                        p.trace.record_span(
+                            "batch.peer_wait", t_read1, t_f0,
+                            parent=p.parent_span, stage="finish",
+                        )
+                    with tracing.span("suggest.finish"):
+                        with study.lock:
+                            docs = finish(o)
+                            payload = study.commit_suggest(
+                                docs, p.draw_index,
+                                idempotency_key=p.idempotency_key,
+                            )
             except Exception as e:
                 if is_device_error(e):
                     raise
@@ -1153,6 +1280,7 @@ class SuggestScheduler:
                 )
                 self._fail(p, e)
                 continue
+            self.stats.record_phase("finish", time.monotonic() - t_f0)
             self._complete(p, docs, payload=payload)
 
     # -- drain / shutdown ----------------------------------------------
@@ -1194,15 +1322,24 @@ class OptimizationService:
                  max_batch=DEFAULT_MAX_BATCH, max_queue=DEFAULT_MAX_QUEUE,
                  max_studies=DEFAULT_MAX_STUDIES,
                  suggest_timeout=DEFAULT_SUGGEST_TIMEOUT,
-                 fault_stats=None, startup_fsck=True):
+                 fault_stats=None, startup_fsck=True, tracer=None):
         self.stats = ServiceStats()
         self.timings = PhaseTimings()
+        self.tracer = tracer if tracer is not None else tracing.DISABLED
         self.fault_stats = (
             fault_stats if fault_stats is not None else FaultStats()
         )
         from ..resilience.device import DeviceRecovery
 
         self.device_recovery = DeviceRecovery(stats=self.fault_stats)
+        # compile attribution: a tpe_device trace-time observer turns
+        # every XLA retrace of the fused suggest program into a counted
+        # (trial-bucket, family) event AND a span on the trace that paid
+        # for it (the scheduler binds the batch leader's trace around
+        # the fused launch).  Installed whether or not tracing samples —
+        # hyperopt_compile_events_total must count regardless.
+        self._compile_observer = None
+        self._install_compile_observer()
         # startup order is the recovery protocol: fsck the root FIRST
         # (quarantine torn docs, clear orphan leases/locks/tmp, trim a
         # torn journal tail), then let the registry rebuild each study
@@ -1232,6 +1369,50 @@ class OptimizationService:
         # forever — degraded-but-serving beats never-ready)
         self._ready_lock = threading.Lock()
         self._device_state = "cold"  # guarded-by: _ready_lock
+
+    def _install_compile_observer(self):
+        from ..algos import tpe_device
+
+        stats = self.stats
+
+        def _on_program_trace(sig, shapes):
+            bucket, families = tpe_device.compile_key(sig, shapes)
+            stats.record_compile(bucket, families)
+            tracing.add_event(
+                "compile", bucket=int(bucket), families=families,
+            )
+
+        tpe_device._trace_observers.append(_on_program_trace)
+        self._compile_observer = _on_program_trace
+
+    def _uninstall_compile_observer(self):
+        if self._compile_observer is None:
+            return
+        from ..algos import tpe_device
+
+        try:
+            tpe_device._trace_observers.remove(self._compile_observer)
+        except ValueError:
+            pass
+        self._compile_observer = None
+
+    @contextlib.contextmanager
+    def _traced_request(self, name, **attrs):
+        """Root-span plumbing for one service request: adopt the ambient
+        trace (the HTTP layer began it from the X-Hyperopt-Trace header)
+        or begin one here (direct in-process callers), open the root
+        span, and — only when begun here — finish/write the trace."""
+        trace = tracing.current_trace()
+        owned = None
+        if trace is None and self.tracer.enabled:
+            owned = trace = self.tracer.begin()
+        try:
+            with tracing.use_trace(trace):
+                with tracing.span(name, **attrs) as root:
+                    yield trace, root
+        finally:
+            if owned is not None:
+                self.tracer.finish(owned)
 
     def _run_startup_fsck(self, root):
         from ..resilience.fsck import fsck_path
@@ -1277,46 +1458,55 @@ class OptimizationService:
     def create_study(self, study_id, space, seed=0, algo="tpe",
                      algo_params=None, exist_ok=False,
                      idempotency_key=None) -> dict:
-        with self.timings.phase("create_study"):
-            try:
-                study = self.registry.create(
-                    study_id, space, seed=seed, algo_name=algo,
-                    algo_params=algo_params, exist_ok=exist_ok,
-                )
-            except BackpressureError:
-                # registry-full 429s must show on the same rejection
-                # counter operators watch for suggest over-admission
-                self.stats.record_rejection("create_study")
-                raise
-            except StudyExists:
-                if idempotency_key is None:
-                    raise
-                # a RETRIED create (same idempotency key) replays the
-                # journaled response byte-for-byte.  A keyed create hitting
-                # an existing study whose journal misses the key can still
-                # be the retry of a create that crashed BETWEEN persisting
-                # the config and journaling the response — a config match
-                # proves it is the same logical create, so it attaches (a
-                # keyed create is "create exactly this study": idempotent
-                # by content).  Only a config MISMATCH keeps the 409.
-                study = self.registry.get(study_id)
-                with study.lock:
-                    replay = study.journal.payload(
-                        idempotency_key, kind="create_study"
+        with self._traced_request(
+            "service.create_study", study=str(study_id)
+        ) as (_trace, root):
+            with self.timings.phase("create_study"):
+                try:
+                    study = self.registry.create(
+                        study_id, space, seed=seed, algo_name=algo,
+                        algo_params=algo_params, exist_ok=exist_ok,
                     )
-                if replay is not None:
-                    self.stats.record_replay("create_study")
-                    self.stats.record_request("create_study")
-                    return replay
-                if not study.config_matches(space, seed, algo, algo_params):
+                except BackpressureError:
+                    # registry-full 429s must show on the same rejection
+                    # counter operators watch for suggest over-admission
+                    self.stats.record_rejection("create_study")
                     raise
-        with study.lock:
-            payload = study.status()
-            if idempotency_key is not None:
-                study.journal.record(
-                    idempotency_key, "create_study",
-                    canonical_json(payload),
-                )
+                except StudyExists:
+                    if idempotency_key is None:
+                        raise
+                    # a RETRIED create (same idempotency key) replays the
+                    # journaled response byte-for-byte.  A keyed create
+                    # hitting an existing study whose journal misses the
+                    # key can still be the retry of a create that crashed
+                    # BETWEEN persisting the config and journaling the
+                    # response — a config match proves it is the same
+                    # logical create, so it attaches (a keyed create is
+                    # "create exactly this study": idempotent by
+                    # content).  Only a config MISMATCH keeps the 409.
+                    study = self.registry.get(study_id)
+                    with study.lock:
+                        replay = study.journal.payload(
+                            idempotency_key, kind="create_study"
+                        )
+                    if replay is not None:
+                        root.set_attr("replay", True)
+                        self.stats.record_replay("create_study")
+                        self.stats.record_request(
+                            "create_study", replay=True
+                        )
+                        return replay
+                    if not study.config_matches(
+                        space, seed, algo, algo_params
+                    ):
+                        raise
+            with study.lock:
+                payload = study.status()
+                if idempotency_key is not None:
+                    study.journal.record(
+                        idempotency_key, "create_study",
+                        canonical_json(payload),
+                    )
         self.stats.record_request("create_study")
         self.stats.set_n_studies(len(self.registry))
         return payload
@@ -1334,38 +1524,70 @@ class OptimizationService:
             raise ValueError("n must be >= 1")
         t0 = time.perf_counter()
         study = self.registry.get(study_id)
-        if idempotency_key is not None:
-            with study.lock:
-                replay = study.journal.payload(
-                    idempotency_key, kind="suggest"
+        with self._traced_request(
+            "service.suggest", study=str(study_id), n=int(n)
+        ) as (trace, root):
+            if idempotency_key is not None:
+                with study.lock:
+                    replay = study.journal.payload(
+                        idempotency_key, kind="suggest"
+                    )
+                    if replay is None:
+                        pending = study._inflight.get(idempotency_key)
+                        if (
+                            pending is not None
+                            and pending.cancelled
+                            and pending.ids is None
+                        ):
+                            # its waiter timed out and the scheduler will
+                            # abandon it without consuming anything —
+                            # attaching would inherit that spurious failure.
+                            # Replace it; one with ids drawn still completes
+                            # and journals, so THAT one we do attach to.
+                            pending = None
+                        if pending is None:
+                            pending = self.scheduler.submit(
+                                study, n, idempotency_key=idempotency_key,
+                                trace=trace, parent_span=root,
+                            )
+                            study._inflight[idempotency_key] = pending
+                if replay is not None:
+                    # a journal hit is NOT a served suggest: tag it in
+                    # the trace and keep it out of the latency
+                    # histogram — a burst of retries must not fake a
+                    # fast p50 or mask a slow p99
+                    root.set_attr("replay", True)
+                    self.stats.record_replay("suggest")
+                    self.stats.record_request(
+                        "suggest", study=study_id, replay=True
+                    )
+                    return replay
+            else:
+                pending = self.scheduler.submit(
+                    study, n, trace=trace, parent_span=root
                 )
-                if replay is None:
-                    pending = study._inflight.get(idempotency_key)
-                    if (
-                        pending is not None
-                        and pending.cancelled
-                        and pending.ids is None
-                    ):
-                        # its waiter timed out and the scheduler will
-                        # abandon it without consuming anything —
-                        # attaching would inherit that spurious failure.
-                        # Replace it; one with ids drawn still completes
-                        # and journals, so THAT one we do attach to.
-                        pending = None
-                    if pending is None:
-                        pending = self.scheduler.submit(
-                            study, n, idempotency_key=idempotency_key
-                        )
-                        study._inflight[idempotency_key] = pending
-            if replay is not None:
-                self.stats.record_replay("suggest")
-                self.stats.record_request("suggest", study=study_id)
-                return replay
-        else:
-            pending = self.scheduler.submit(study, n)
-        pending.wait(
-            self.suggest_timeout if timeout is None else timeout
-        )
+            if trace is not None and pending.trace is trace:
+                # admission: root entry → enqueue (journal lookup +
+                # submit, possibly blocked on a contended study lock).
+                # Skipped when this is a retry attached to an EARLIER
+                # request's pending — its intervals belong to that trace.
+                trace.record_span(
+                    "suggest.admit", root.t0, pending.enqueued_at,
+                    parent=root,
+                )
+            pending.wait(
+                self.suggest_timeout if timeout is None else timeout
+            )
+            if (
+                trace is not None
+                and pending.trace is trace
+                and pending.completed_at is not None
+            ):
+                # hand-back: scheduler completion → this thread resumed
+                trace.record_span(
+                    "suggest.wake", pending.completed_at,
+                    time.monotonic(), parent=root,
+                )
         dt = time.perf_counter() - t0
         self.stats.record_request("suggest", seconds=dt, study=study_id)
         self.timings.record("suggest", dt)
@@ -1374,20 +1596,26 @@ class OptimizationService:
     def report(self, study_id, tid, loss=None, status=STATUS_OK,
                result=None, idempotency_key=None) -> dict:
         study = self.registry.get(study_id)
-        with self.timings.phase("report"):
-            with study.lock:
-                if idempotency_key is not None:
-                    replay = study.journal.payload(
-                        idempotency_key, kind="report"
+        with self._traced_request(
+            "service.report", study=str(study_id), tid=int(tid)
+        ) as (_trace, root):
+            with self.timings.phase("report"):
+                with study.lock:
+                    if idempotency_key is not None:
+                        replay = study.journal.payload(
+                            idempotency_key, kind="report"
+                        )
+                        if replay is not None:
+                            root.set_attr("replay", True)
+                            self.stats.record_replay("report")
+                            self.stats.record_request(
+                                "report", replay=True
+                            )
+                            return replay
+                    doc = study.report(
+                        tid, loss=loss, status=status, result=result,
+                        idempotency_key=idempotency_key,
                     )
-                    if replay is not None:
-                        self.stats.record_replay("report")
-                        self.stats.record_request("report")
-                        return replay
-                doc = study.report(
-                    tid, loss=loss, status=status, result=result,
-                    idempotency_key=idempotency_key,
-                )
         self.stats.record_request("report")
         return {"tid": int(doc["tid"]), "state": doc["state"]}
 
@@ -1410,6 +1638,7 @@ class OptimizationService:
             "faults": self.fault_stats.summary(),
             "recovery": dict(self.registry.recovery_info),
             "fsck": self.fsck_report,
+            "tracing": self.tracer.summary(),
         }
 
     def readiness(self) -> dict:
@@ -1456,3 +1685,4 @@ class OptimizationService:
     def close(self, timeout=60.0):
         self._closed = True
         self.scheduler.close(timeout=timeout)
+        self._uninstall_compile_observer()

@@ -50,6 +50,7 @@ import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from .. import tracing
 from ..base import STATUS_OK
 from .core import (
     BackpressureError,
@@ -102,6 +103,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        trace = getattr(self, "_active_trace", None)
+        if trace is not None:
+            # echo the trace id so the caller can join its client-side
+            # spans (and logs) to the server's trace record
+            self.send_header(tracing.TRACE_HEADER, trace.trace_id)
         for k, v in headers:
             self.send_header(k, v)
         self.end_headers()
@@ -257,7 +263,23 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._send(404, {"error": "NotFound", "detail": path})
 
-        self._dispatch(handle)
+        # header contract: the study routes accept a caller-assigned
+        # trace id via X-Hyperopt-Trace (one is assigned here when the
+        # header is absent), bind it for the handler, and echo it back.
+        # begin() returns None when tracing is disabled — every span
+        # call downstream then no-ops (the sampling-off hot path).
+        trace = None
+        if path.startswith("/v1/studies"):
+            trace = self.service.tracer.begin(
+                self.headers.get(tracing.TRACE_HEADER)
+            )
+        self._active_trace = trace
+        try:
+            with tracing.use_trace(trace):
+                self._dispatch(handle)
+        finally:
+            self._active_trace = None
+            self.service.tracer.finish(trace)
 
 
 class ServiceServer:

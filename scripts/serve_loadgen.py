@@ -66,8 +66,13 @@ def _objective(point, rng):
 
 
 def run_loadgen(n_studies=8, n_trials=20, seed=0, batch_window=0.004,
-                root=None):
-    """Run the seeded campaign; returns the BENCH_SERVE.json payload."""
+                root=None, tracer=None):
+    """Run the seeded campaign; returns the BENCH_SERVE.json payload.
+
+    ``tracer``: an optional :class:`hyperopt_tpu.tracing.Tracer` — the
+    server traces every sampled request end-to-end (clients send
+    ``X-Hyperopt-Trace`` ids by default) and the caller aggregates the
+    trace log afterwards (``scripts/trace_report.py``)."""
     from hyperopt_tpu.fmin import space_eval
     from hyperopt_tpu.service import (
         OptimizationService,
@@ -76,7 +81,9 @@ def run_loadgen(n_studies=8, n_trials=20, seed=0, batch_window=0.004,
     )
 
     space = _space()
-    service = OptimizationService(root=root, batch_window=batch_window)
+    service = OptimizationService(
+        root=root, batch_window=batch_window, tracer=tracer
+    )
     server = ServiceServer(service).start()
     errors = []
     t0 = time.perf_counter()
@@ -112,6 +119,10 @@ def run_loadgen(n_studies=8, n_trials=20, seed=0, batch_window=0.004,
             errors.append(f"{len(alive)} study clients timed out")
         wall_s = time.perf_counter() - t0
         stats = service.stats.summary()
+        # exact quantiles over the full run (the ring window exceeds
+        # the sample count here) — the histogram-derived numbers in
+        # ``stats`` are bucket-interpolated, too coarse for A/B deltas
+        exact = service.stats.window_quantiles()
         completed = {
             sid: service.study_status(sid)["n_completed"]
             for sid in service.list_studies()
@@ -140,6 +151,8 @@ def run_loadgen(n_studies=8, n_trials=20, seed=0, batch_window=0.004,
         "total_suggest_requests": total_suggests,
         "suggest_p50_ms": stats["suggest_latency"]["p50_ms"],
         "suggest_p99_ms": stats["suggest_latency"]["p99_ms"],
+        "suggest_p50_exact_ms": exact["p50_ms"],
+        "suggest_p99_exact_ms": exact["p99_ms"],
         "mean_batch_occupancy": occ,
         "n_dispatches": stats["n_dispatches"],
         "n_batched_suggests": stats["n_batched_suggests"],
@@ -159,6 +172,81 @@ def _platform():
     return jax.devices()[0].platform
 
 
+def run_traced(n_studies, n_trials, seed, batch_window, trace_sample,
+               trace_slow_ms=None, trace_log=None, overhead_check=False,
+               min_coverage=0.9):
+    """The traced campaign: run the loadgen with request tracing on,
+    aggregate the trace log, and (optionally) measure the tracing-off
+    overhead.  Returns (bench_report, trace_report)."""
+    import tempfile
+
+    scripts_dir = os.path.dirname(os.path.abspath(__file__))
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    import trace_report as trace_report_mod
+
+    from hyperopt_tpu.tracing import Tracer
+
+    if trace_log is None:
+        trace_log = os.path.join(
+            tempfile.mkdtemp(prefix="hyperopt-trace-"), "trace.jsonl"
+        )
+    tracer = Tracer(
+        path=trace_log,
+        sample=trace_sample,
+        slow_threshold_s=(
+            None if trace_slow_ms is None else trace_slow_ms / 1e3
+        ),
+    )
+    bench = run_loadgen(
+        n_studies=n_studies, n_trials=n_trials, seed=seed,
+        batch_window=batch_window, tracer=tracer,
+    )
+    trep = trace_report_mod.report_for_log(
+        trace_log, min_coverage=min_coverage
+    )
+    trep["tracer"] = tracer.summary()
+    trep["bench"] = {
+        "n_studies": n_studies,
+        "n_trials_per_study": n_trials,
+        "seed": seed,
+        "suggest_p50_ms": bench["suggest_p50_ms"],
+        "suggest_p99_ms": bench["suggest_p99_ms"],
+        "platform": bench["platform"],
+    }
+    trep["ok"] = bool(trep["ok"] and bench["ok"])
+    if overhead_check:
+        # the sampling-off acceptance: a tracer at sample 0 must be a
+        # no-op on the hot path (p50 within 5% of a tracer-less run).
+        # Interleaved A/B pairs with EXACT (not bucket-interpolated)
+        # p50s; min-of-runs per config is the standard noise-robust
+        # latency estimator (host jitter only ever adds time).
+        base_p50s, off_p50s = [], []
+        for _ in range(2):
+            base = run_loadgen(
+                n_studies=n_studies, n_trials=n_trials, seed=seed,
+                batch_window=batch_window, tracer=None,
+            )
+            base_p50s.append(base["suggest_p50_exact_ms"])
+            off = run_loadgen(
+                n_studies=n_studies, n_trials=n_trials, seed=seed,
+                batch_window=batch_window, tracer=Tracer(sample=0.0),
+            )
+            off_p50s.append(off["suggest_p50_exact_ms"])
+        p50_base, p50_off = min(base_p50s), min(off_p50s)
+        trep["overhead"] = {
+            "p50_untraced_ms": p50_base,
+            "p50_sample0_ms": p50_off,
+            "p50_untraced_runs_ms": base_p50s,
+            "p50_sample0_runs_ms": off_p50s,
+            "p50_regression_frac": (
+                round(p50_off / p50_base - 1.0, 4) if p50_base else None
+            ),
+            "gate_frac": 0.05,
+        }
+    return bench, trep
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--studies", type=int, default=8)
@@ -175,8 +263,46 @@ def main(argv=None):
             "BENCH_SERVE.json",
         ),
     )
+    ap.add_argument("--trace", action="store_true",
+                    help="trace every request and emit TRACE_SERVE.json")
+    ap.add_argument("--trace-sample", type=float, default=1.0,
+                    dest="trace_sample")
+    ap.add_argument("--trace-slow-ms", type=float, default=None,
+                    dest="trace_slow_ms")
+    ap.add_argument("--trace-log", default=None, dest="trace_log",
+                    help="trace log path (default: a fresh tmp dir)")
+    ap.add_argument(
+        "--trace-out",
+        default=os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "TRACE_SERVE.json",
+        ),
+        dest="trace_out",
+    )
+    ap.add_argument(
+        "--overhead-check", action="store_true", dest="overhead_check",
+        help="also run untraced and sample=0 campaigns and report the "
+             "p50 regression (the tracing-off-is-free acceptance)",
+    )
     options = ap.parse_args(argv)
     n_trials = 8 if options.quick else options.trials
+    if options.trace:
+        report, trep = run_traced(
+            n_studies=options.studies,
+            n_trials=n_trials,
+            seed=options.seed,
+            batch_window=options.batch_window,
+            trace_sample=options.trace_sample,
+            trace_slow_ms=options.trace_slow_ms,
+            trace_log=options.trace_log,
+            overhead_check=options.overhead_check,
+        )
+        print(json.dumps(trep, indent=1))
+        if options.trace_out:
+            with open(options.trace_out, "w") as f:
+                json.dump(trep, f, indent=1)
+                f.write("\n")
+        return 0 if trep["ok"] else 1
     report = run_loadgen(
         n_studies=options.studies,
         n_trials=n_trials,

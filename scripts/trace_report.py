@@ -1,0 +1,254 @@
+"""Aggregate a service trace log into a phase-attributed latency report.
+
+Reads the crash-tolerant JSONL trace log the optimization server writes
+(``hyperopt_tpu.tracing``, one CRC-checked record per sampled request)
+and emits ``TRACE_SERVE.json``:
+
+- **phase breakdown** — p50/p95/p99 and total attributed seconds per
+  named span (queue wait, batch coalesce, prepare, fused device
+  dispatch, readback, finish, journal fsync, store insert, ...), so a
+  slow suggest decomposes into named milliseconds instead of one opaque
+  number;
+- **coverage** — per trace, the fraction of the request's server
+  wall-time accounted for by the TILING spans (the phase spans designed
+  to partition the root interval).  The acceptance gate: every sampled
+  fresh suggest ≥ 90% covered — no dark time;
+- **top-N slowest traces** with each one's dominant phase — the p99
+  explained, request by request;
+- **compile attribution** — every XLA compile event observed during the
+  run, with the (trial-bucket, family) key and the trace id that paid
+  for it (the ROADMAP's compile-storm hypothesis as a measured fact).
+
+Usage::
+
+    python scripts/trace_report.py <trace.jsonl> [--out TRACE_SERVE.json]
+        [--top 10] [--min-coverage 0.9]
+
+Exit code 0 iff the coverage gate holds and every compile event is
+attributed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The spans that PARTITION a suggest's server wall-time (each request's
+# root interval tiles into these, by construction in
+# service/core.py::SuggestScheduler).  Nested detail spans
+# (journal.fsync inside suggest.finish, store.write_doc inside
+# store.insert, ...) are reported as phases but excluded from the
+# coverage sum — they would double-count their parents.
+TILING_SPANS = frozenset({
+    "suggest.admit",
+    "suggest.queue_wait",
+    "suggest.coalesce",
+    "batch.peer_wait",
+    "suggest.draw",
+    "suggest.prepare",
+    "device.dispatch",
+    "device.readback",
+    "suggest.finish",
+    "suggest.wake",
+    "suggest.inline",
+})
+
+
+def _percentile(sorted_vals, q):
+    """Nearest-rank-interpolated percentile over a pre-sorted list."""
+    if not sorted_vals:
+        return None
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def _quantiles_ms(values):
+    vals = sorted(values)
+    return {
+        "p50_ms": (
+            round(_percentile(vals, 0.50) * 1e3, 3) if vals else None
+        ),
+        "p95_ms": (
+            round(_percentile(vals, 0.95) * 1e3, 3) if vals else None
+        ),
+        "p99_ms": (
+            round(_percentile(vals, 0.99) * 1e3, 3) if vals else None
+        ),
+    }
+
+
+def trace_coverage(record) -> float:
+    """Fraction of this trace's root wall-time accounted for by the
+    tiling phase spans (clamped to 1.0 — boundary timestamps may
+    overlap by a clock quantum)."""
+    dur = record.get("duration_s")
+    if not dur or dur <= 0:
+        return 1.0  # zero-length root: nothing to attribute
+    covered = sum(
+        s["dur_s"] for s in record.get("spans", ())
+        if s["name"] in TILING_SPANS
+    )
+    return min(1.0, covered / dur)
+
+
+def dominant_span(record):
+    """(name, dur_s) of the largest tiling span (None for a replay or
+    span-less trace)."""
+    best = None
+    for s in record.get("spans", ()):
+        if s["name"] not in TILING_SPANS:
+            continue
+        if best is None or s["dur_s"] > best["dur_s"]:
+            best = s
+    if best is None:
+        return None
+    return {"name": best["name"], "dur_s": round(best["dur_s"], 6)}
+
+
+def analyze(records, top_n=10, min_coverage=0.9) -> dict:
+    """The TRACE_SERVE.json payload for a list of trace records."""
+    suggests = [r for r in records if r.get("root") == "service.suggest"]
+    fresh = [
+        r for r in suggests
+        if not (r.get("root_attrs") or {}).get("replay")
+    ]
+    replays = len(suggests) - len(fresh)
+
+    # -- per-phase aggregation over fresh suggest traces ---------------
+    phase_durs = {}
+    for r in fresh:
+        for s in r.get("spans", ()):
+            phase_durs.setdefault(s["name"], []).append(s["dur_s"])
+    total_root_s = sum(r.get("duration_s") or 0.0 for r in fresh)
+    phases = {}
+    for name, durs in sorted(phase_durs.items()):
+        total = sum(durs)
+        phases[name] = {
+            "count": len(durs),
+            "total_s": round(total, 6),
+            "share_of_wall": (
+                round(total / total_root_s, 4) if total_root_s else None
+            ),
+            "tiling": name in TILING_SPANS,
+            **_quantiles_ms(durs),
+        }
+
+    # -- coverage gate -------------------------------------------------
+    coverages = [trace_coverage(r) for r in fresh]
+    coverage = {
+        "min": round(min(coverages), 4) if coverages else None,
+        "mean": (
+            round(sum(coverages) / len(coverages), 4) if coverages else None
+        ),
+        "n_below_gate": sum(1 for c in coverages if c < min_coverage),
+        "gate": min_coverage,
+    }
+
+    # -- top-N slowest, each with its dominant phase -------------------
+    slowest = sorted(
+        fresh, key=lambda r: r.get("duration_s") or 0.0, reverse=True
+    )[:top_n]
+    top = [
+        {
+            "trace_id": r["trace_id"],
+            "duration_ms": round((r.get("duration_s") or 0.0) * 1e3, 3),
+            "study": (r.get("root_attrs") or {}).get("study"),
+            "dominant": dominant_span(r),
+            "coverage": round(trace_coverage(r), 4),
+            "n_compiles": sum(
+                1 for s in r.get("spans", ()) if s["name"] == "compile"
+            ),
+        }
+        for r in slowest
+    ]
+
+    # -- compile attribution (over ALL records, not just suggests) -----
+    compiles = []
+    for r in records:
+        for s in r.get("spans", ()):
+            if s["name"] != "compile":
+                continue
+            attrs = s.get("attrs") or {}
+            compiles.append({
+                "trace_id": r["trace_id"],
+                "root": r.get("root"),
+                "bucket": attrs.get("bucket"),
+                "families": attrs.get("families"),
+            })
+    compiles_attributed = all(
+        c["trace_id"] and c["bucket"] is not None and c["families"]
+        for c in compiles
+    )
+    by_key = {}
+    for c in compiles:
+        key = f"{c['bucket']}/{c['families']}"
+        by_key[key] = by_key.get(key, 0) + 1
+
+    ok = (
+        bool(fresh)
+        and coverage["n_below_gate"] == 0
+        and compiles_attributed
+    )
+    return {
+        "metric": "trace_serve",
+        "ok": ok,
+        "n_traces": len(records),
+        "n_suggest_traces": len(suggests),
+        "n_replay_traces": replays,
+        "suggest_latency": _quantiles_ms(
+            [r.get("duration_s") or 0.0 for r in fresh]
+        ),
+        "coverage": coverage,
+        "phases": phases,
+        "top_slowest": top,
+        "compile_events": {
+            "n": len(compiles),
+            "attributed": compiles_attributed,
+            "by_key": dict(sorted(by_key.items())),
+            "events": compiles,
+        },
+    }
+
+
+def report_for_log(path, top_n=10, min_coverage=0.9) -> dict:
+    from hyperopt_tpu.tracing import read_trace_log
+
+    records, torn = read_trace_log(path)
+    out = analyze(records, top_n=top_n, min_coverage=min_coverage)
+    out["trace_log"] = path
+    out["torn_records"] = torn
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace_log", help="path to the server's trace JSONL")
+    ap.add_argument("--out", default=None,
+                    help="also write the report JSON here")
+    ap.add_argument("--top", type=int, default=10)
+    ap.add_argument("--min-coverage", type=float, default=0.9,
+                    dest="min_coverage")
+    options = ap.parse_args(argv)
+    report = report_for_log(
+        options.trace_log, top_n=options.top,
+        min_coverage=options.min_coverage,
+    )
+    print(json.dumps(report, indent=1))
+    if options.out:
+        with open(options.out, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

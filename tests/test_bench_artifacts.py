@@ -93,6 +93,36 @@ def test_batched_suggest_scales_with_k():
     assert rates[-1] > 2 * rates[0], rates
 
 
+TRACE_SERVE = os.path.join(ROOT, "TRACE_SERVE.json")
+
+
+@pytest.mark.skipif(
+    not os.path.exists(TRACE_SERVE), reason="no committed trace artifact"
+)
+def test_trace_serve_artifact_attributes_the_tail():
+    """The ISSUE-6 acceptance artifact: named tiling spans cover >= 90%
+    of every sampled suggest's server wall-time, every XLA compile
+    event is attributed to a trace id and (bucket, family) key, and
+    sampling-off tracing is free (p50 within 5% of untraced)."""
+    d = _load(TRACE_SERVE)
+    assert d["metric"] == "trace_serve"
+    assert d["ok"] is True
+    assert d["n_suggest_traces"] > 0
+    assert d["coverage"]["n_below_gate"] == 0
+    assert d["coverage"]["min"] >= 0.9
+    ce = d["compile_events"]
+    assert ce["attributed"] is True
+    for ev in ce["events"]:
+        # bucket 0 is compile_key's documented fallback and still
+        # attributed — mirror trace_report's own gate exactly
+        assert ev["trace_id"] and ev["bucket"] is not None and ev["families"]
+    # the tail is EXPLAINED: every slow trace names a dominant phase
+    for t in d["top_slowest"]:
+        assert t["dominant"] is not None and t["coverage"] >= 0.9
+    if "overhead" in d:
+        assert d["overhead"]["p50_regression_frac"] < 0.05
+
+
 @needs_tpu_json
 @pytest.mark.skipif(
     not os.path.exists(TPU_100K), reason="no committed 100k artifact"

@@ -765,12 +765,27 @@ class TestServiceStats:
         assert s.mean_batch_occupancy == 2.0
         for ms in (1, 2, 100):
             s.record_request("suggest", seconds=ms / 1e3, study="a")
+        # exported quantiles come from the fixed-bucket histogram:
+        # exact at bucket edges, interpolated inside the bucket — the
+        # p50 (2 ms) must land inside its (1, 2.5] ms bucket
         q = s.latency_quantiles()
-        assert q["p50_ms"] == pytest.approx(2.0, abs=0.1)
+        assert 1.0 <= q["p50_ms"] <= 2.5
         assert q["p99_ms"] > 50
+        # the ring keeps the exact recent sample (human JSON only),
+        # and says how wide its window is
+        w = s.window_quantiles()
+        assert w["p50_ms"] == pytest.approx(2.0, abs=0.1)
+        assert w["window"] == 3
+        assert w["max_window"] == 65536
         summ = s.summary()
         assert summ["study_suggests"] == {"a": 3}
         assert summ["n_dispatches"] == 2
+        assert summ["suggest_latency_window"]["window"] == 3
+        # a replayed suggest is tagged: counted as a request, kept OUT
+        # of the latency histogram and the per-study suggest counter
+        s.record_request("suggest", seconds=5.0, study="a", replay=True)
+        assert s.summary()["study_suggests"] == {"a": 3}
+        assert s.latency_quantiles()["p99_ms"] == q["p99_ms"]
 
     def test_rejections_and_gauges(self):
         s = ServiceStats()
