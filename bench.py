@@ -54,17 +54,20 @@ LF = 25
 TIMED_SUGGESTS = int(os.environ.get("BENCH_TIMED", 30))
 LOOP_ITERS = int(os.environ.get("BENCH_LOOP_ITERS", 50))
 
-# v5e peak: 197 TFLOP/s bf16 MXU (f32 runs at a fraction of this; MFU is
-# reported against the bf16 peak, i.e. conservatively low)
-TPU_PEAK_TFLOPS = 197.0
+# Hardware ceilings (v5e: 197 TFLOP/s bf16 MXU, 819 GB/s HBM; MFU is
+# reported against the bf16 peak, i.e. conservatively low) live in ONE
+# place — hyperopt_tpu.profiling.platform_peaks — with env overrides
+# HYPEROPT_TPU_PEAK_TFLOPS / HYPEROPT_TPU_PEAK_HBM_GBPS for other chip
+# generations; every bench field derives from that table.
 
 
-def build_history_trials():
-    """10k completed trials over a 5-label mixed space (doc-building cost
-    excluded from timing)."""
+def build_history_trials(n_history=None):
+    """``n_history`` (default ``N_HISTORY``) completed trials over a
+    5-label mixed space (doc-building cost excluded from timing)."""
     from hyperopt_tpu import Trials, hp
     from hyperopt_tpu.base import Domain
 
+    n = N_HISTORY if n_history is None else int(n_history)
     space = {
         "lr": hp.loguniform("lr", np.log(1e-5), np.log(1.0)),
         "momentum": hp.uniform("momentum", 0.0, 1.0),
@@ -74,10 +77,10 @@ def build_history_trials():
     }
     domain = Domain(lambda c: 0.0, space)
     rng = np.random.default_rng(0)
-    vals, _ = domain.space.sample_batch(0, N_HISTORY)
-    losses = rng.standard_normal(N_HISTORY)
+    vals, _ = domain.space.sample_batch(0, n)
+    losses = rng.standard_normal(n)
     docs = []
-    for i in range(N_HISTORY):
+    for i in range(n):
         docs.append(_done_doc(i, {k: float(vals[k][i]) for k in vals}, float(losses[i])))
     trials = Trials()
     trials._insert_trial_docs(docs)
@@ -289,6 +292,106 @@ def _scorer_flops(dh, n_cand):
         K = (cap_b + 1) + (fam.cap + 1)
         flops += fam.L * 2 * 3 * n_cand * K
     return flops
+
+
+def _scorer_cost(dh, n_cand, scorer="xla"):
+    """{flops, bytes, mxu_flops} of one suggest's PAIR-SCORER work — the
+    HBM-traffic extension of :func:`_scorer_flops`, restricted to the
+    non-quantized continuous families (the same set ``suggest_ei_evals``
+    credits, so rate / cost / roofline all describe identical work; the
+    memory model per scorer implementation lives in
+    ``hyperopt_tpu.ops.score.pair_score_cost``)."""
+    from hyperopt_tpu.ops.score import pair_score_cost
+
+    cap_b = _derived_cap_b()
+    out = {"flops": 0.0, "bytes": 0.0, "mxu_flops": 0.0}
+    for fam in dh.families.values():
+        if fam.key[0] != "cont" or fam.quantized:
+            continue
+        K = (cap_b + 1) + (fam.cap + 1)
+        cost = pair_score_cost(n_cand, K, scorer)
+        for key in out:
+            out[key] += fam.L * cost[key]
+    return out
+
+
+def device_headline_fields(cost, suggest_ei_evals, device_ei_rate,
+                           platform, scorer):
+    """The device-plane headline fields, roofline-attributed.
+
+    THE null contract (VERDICT r6 #4): a field whose measurement is
+    unavailable is ``null`` with a non-null ``unmeasured_reason`` —
+    never a silent ``0.0`` placeholder (``BENCH_TPU_100k.json``
+    originally shipped ``achieved_tflops: 0.0`` / ``mfu_pct: 0.0``
+    because the scorer A/B had been skipped).
+
+    - ``achieved_tflops`` / ``achieved_GBps`` / ``binding_ceiling`` /
+      ``roofline_pct*``: from the full analytical scorer cost model
+      (``_scorer_cost``) against the platform roofline ceilings
+      (``profiling.platform_peaks`` — nominal, flagged, off-TPU);
+    - ``mfu_pct`` keeps its historical meaning — matmul-only FLOPs
+      against the TPU bf16 MXU peak — and is null off-TPU
+      (``mfu_pct_reason`` says why).
+    """
+    from hyperopt_tpu import profiling
+
+    peaks = profiling.platform_peaks(platform)
+    out = {
+        "device_scorer_ms_per_suggest": None,
+        "achieved_tflops": None,
+        "achieved_GBps": None,
+        "mfu_pct": None,
+        "mfu_pct_reason": (
+            None if platform == "tpu" else
+            "mfu_pct is defined against the TPU bf16 MXU peak; "
+            f"platform is {platform}"
+        ),
+        "binding_ceiling": None,
+        "roofline_pct": None,
+        "roofline_pct_bw": None,
+        "roofline_pct_mxu": None,
+        "roofline_scorer": scorer,
+        "scorer_traffic_gbytes_per_suggest": None,
+        "peaks": {
+            k: peaks[k]
+            for k in ("peak_tflops", "peak_hbm_GBps", "source")
+        },
+        "unmeasured_reason": None,
+    }
+    if device_ei_rate <= 0 or not suggest_ei_evals:
+        reasons = []
+        if device_ei_rate <= 0:
+            reasons.append(
+                "device-plane scorer rate unavailable"
+                + (
+                    " (scorer A/B disabled: BENCH_AB=0)"
+                    if os.environ.get("BENCH_AB") == "0" else ""
+                )
+            )
+        if not suggest_ei_evals:
+            reasons.append("no non-quantized continuous families")
+        out["unmeasured_reason"] = "; ".join(reasons)
+        return out
+    scorer_s = suggest_ei_evals / device_ei_rate
+    roof = profiling.roofline(cost["flops"], cost["bytes"], scorer_s, peaks)
+    out["device_scorer_ms_per_suggest"] = round(scorer_s * 1e3, 3)
+    out["achieved_tflops"] = round(roof["achieved_tflops"], 4)
+    out["achieved_GBps"] = round(roof["achieved_GBps"], 2)
+    out["binding_ceiling"] = roof["binding_ceiling"]
+    out["roofline_pct"] = round(roof["roofline_pct"], 3)
+    out["roofline_pct_bw"] = round(roof["roofline_pct_bw"], 3)
+    out["roofline_pct_mxu"] = round(roof["roofline_pct_mxu"], 3)
+    out["scorer_traffic_gbytes_per_suggest"] = round(
+        cost["bytes"] / 1e9, 4
+    )
+    if platform == "tpu":
+        # same (possibly env-overridden) peak as the roofline fields —
+        # the two must never disagree within one artifact
+        out["mfu_pct"] = round(
+            100.0 * (cost["mxu_flops"] / scorer_s / 1e12)
+            / peaks["peak_tflops"], 3,
+        )
+    return out
 
 
 def _tpu_smoke():
@@ -603,7 +706,53 @@ def trace_section(argv):
     return 0 if trep["ok"] else 1
 
 
+def device_profile_section(argv):
+    """``python bench.py --device-profile [--quick]``: device-plane
+    observability smoke — runs the roofline-profiled suggest workload
+    (scripts/device_report.py) on CPU and writes ``DEVICE_PROFILE.json``
+    (per-signature roofline table, binding-ceiling histogram, duty
+    cycle, memory watermarks, observer-overhead check); asserts every
+    dispatch carries a non-null binding ceiling and roofline_pct.  A
+    real-hardware capture runs ``scripts/device_report.py`` directly on
+    the TPU host.  Prints ONE JSON line like the other bench
+    sections."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    device_report = _import_script("device_report")
+    quick = "--quick" in argv
+    # a quick smoke must not clobber the committed full-run artifact
+    # (the CI default is --quick, run from the repo root)
+    out_path = "DEVICE_PROFILE.quick.json" if quick else "DEVICE_PROFILE.json"
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    t0 = time.time()
+    report = device_report.run_profile(
+        quick=quick, overhead=not quick or "--overhead" in argv
+    )
+    device_report.write_report(report, out_path)
+    out = {
+        "metric": "device_profile_smoke",
+        "value": report["n_dispatches"],
+        "unit": "dispatches",
+        "ok": report["ok"],
+        "platform": report["platform"],
+        "n_signatures": len(report["signatures"]),
+        "unattributed_dispatches": report["unattributed_dispatches"],
+        "duty_cycle": report["duty_cycle"],
+        "binding_ceiling_hist": report["binding_ceiling_hist"],
+        "elapsed_s": round(time.time() - t0, 2),
+    }
+    if report.get("overhead"):
+        out["observer_p50_regression_frac"] = (
+            report["overhead"]["p50_regression_frac"]
+        )
+    print(json.dumps(out))
+    return 0 if report["ok"] else 1
+
+
 def main():
+    if "--device-profile" in sys.argv[1:]:
+        argv = [a for a in sys.argv[1:] if a != "--device-profile"]
+        return device_profile_section(argv)
     if "--wallclock" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--wallclock"]
         return wallclock_section(argv)
@@ -707,12 +856,10 @@ def main():
         for fam in dh.families.values()
         if fam.key[0] == "cont" and not fam.quantized
     )
-    if device_ei_rate > 0 and suggest_ei_evals:
-        device_ms_per_suggest_scorer = suggest_ei_evals / device_ei_rate * 1e3
-        achieved_tflops = flops / (suggest_ei_evals / device_ei_rate) / 1e12
-    else:
-        device_ms_per_suggest_scorer = None
-        achieved_tflops = 0.0
+    dev_fields = device_headline_fields(
+        _scorer_cost(dh, N_EI_CANDIDATES, scorer=smoke_scorer),
+        suggest_ei_evals, device_ei_rate, platform, smoke_scorer,
+    )
 
     # --- numpy baseline (reference-equivalent compute) ----------------
     nrng = np.random.default_rng(0)
@@ -726,9 +873,14 @@ def main():
 
     out = {
         "metric": "tpe_candidate_EI_evals_per_sec_10k_history",
-        "value": round(device_ei_rate, 1),
+        # null contract: an unmeasured headline is null + a reason,
+        # never a 0.0 placeholder (see device_headline_fields)
+        "value": round(device_ei_rate, 1) if device_ei_rate > 0 else None,
         "unit": "EI_evals/s",
-        "vs_baseline": round(device_ei_rate / np_ei_rate, 1) if np_ei_rate else None,
+        "vs_baseline": (
+            round(device_ei_rate / np_ei_rate, 1)
+            if device_ei_rate > 0 and np_ei_rate else None
+        ),
         "baseline_kind": (
             "numpy reimplementation of reference compute at identical "
             "shapes (reference code unobtainable offline); north star is "
@@ -742,11 +894,6 @@ def main():
         "xla_ms_per_suggest_driver_loop": round(xla_per_suggest * 1e3, 3),
         "suggests_per_sec_batched": round(batched_rate, 2),
         "batched_k": kb,
-        "device_scorer_ms_per_suggest": (
-            round(device_ms_per_suggest_scorer, 3)
-            if device_ms_per_suggest_scorer is not None
-            else None
-        ),
         "tunnel_rtt_ms": round(rtt * 1e3, 2),
         "numpy_baseline_ms_per_suggest": round(np_per_suggest * 1e3, 3),
         "numpy_baseline_ei_evals_per_sec": round(np_ei_rate, 1),
@@ -754,12 +901,7 @@ def main():
         "host_bytes_per_suggest": int(host_bytes),
         "device_history_rebuilds": dh.full_rebuilds,
         "scorer_matmul_gflops_per_suggest": round(flops / 1e9, 2),
-        "achieved_tflops": round(achieved_tflops, 4),
-        "mfu_pct": (
-            round(100.0 * achieved_tflops / TPU_PEAK_TFLOPS, 3)
-            if platform == "tpu"
-            else None
-        ),
+        **dev_fields,
         "smoke": {
             "scorer": smoke_scorer,
             "precision_max_err": round(smoke_err, 6),

@@ -35,6 +35,7 @@ relies on.
 
 from __future__ import annotations
 
+import threading
 import time
 import weakref
 from functools import partial
@@ -808,15 +809,29 @@ def _index_family_suggest_core(
 
 _jit_cache = {}
 
-# Static-analyzer hooks (hyperopt_tpu.analysis.program_lint).  Both lists
-# are empty in production — the only overhead is a truthiness check.
-# ``_suggest_observers`` fire host-side once per dispatch with the raw
-# request list (the probe that lets the linter trace the live program to
-# a jaxpr).  ``_trace_observers`` fire at TRACE time inside the jitted
-# callable — each firing is one XLA retrace, the event the recompilation
-# auditor counts against its one-per-(trial-bucket, family) budget.
+# Observer hooks (hyperopt_tpu.analysis.program_lint, resilience.chaos,
+# hyperopt_tpu.profiling).  Both lists are empty by default — the only
+# overhead then is a truthiness check.  ``_suggest_observers`` fire
+# host-side once per fused dispatch with the raw request list (the probe
+# that lets the linter trace the live program to a jaxpr, and the chaos
+# harness's device-error site); an observer that RETURNS a callable gets
+# it invoked when that dispatch's readback resolves, with a timing event
+# ``{n_requests, launch_s, wait_s, readback_s, device_s, out_bytes}`` —
+# the hook the roofline profiler (hyperopt_tpu.profiling.DeviceProfiler)
+# builds per-dispatch device records on.  A dispatch whose resolver is
+# never called (a discarded speculation) fires no completion.
+# ``_trace_observers`` fire at TRACE time inside the jitted callable —
+# each firing is one XLA retrace, the event the recompilation auditor
+# counts against its one-per-(trial-bucket, family) budget.
 _suggest_observers = []
 _trace_observers = []
+
+# Set by the traced callable's body (which only executes at XLA trace
+# time) and read synchronously around each launch: tells the dispatch
+# that just ran whether ITS launch carried a retrace.  Thread-local and
+# read immediately after the (synchronous) launch, so pipelined
+# dispatches on one thread cannot erase each other's flag.
+_trace_tls = threading.local()
 
 
 def _multi_sig(requests):
@@ -861,6 +876,9 @@ def _build_multi_run(requests):
     ]
 
     def run(args_list):
+        # the body of a jitted callable executes only while XLA traces
+        # it — reaching this line IS the retrace event
+        _trace_tls.fired = True
         if _trace_observers:
             shapes = tuple(
                 tuple(
@@ -907,27 +925,74 @@ def multi_family_suggest_async(requests):
     import jax
     import numpy as np
 
+    done_cbs = None
     if _suggest_observers:
         for obs in list(_suggest_observers):
-            obs(requests)
+            cb = obs(requests)
+            if callable(cb):
+                if done_cbs is None:
+                    done_cbs = []
+                done_cbs.append(cb)
     sig = _multi_sig(requests)
     fn = _jit_cache.get(("multi",) + sig)
     if fn is None:
         _, run = _build_multi_run(requests)
         fn = jax.jit(run)
         _jit_cache[("multi",) + sig] = fn
+    _trace_tls.fired = False
+    t_launch0 = time.perf_counter()
     flat_dev = fn([args for _, args, _ in requests])
+    t_launch1 = time.perf_counter()
+    # read back synchronously on the launching thread: True iff THIS
+    # launch traced (and therefore compiled) the program
+    compiled = bool(getattr(_trace_tls, "fired", False))
 
     def resolve():
+        t_read0 = time.perf_counter()
         try:
             flat = np.asarray(flat_dev)  # the ONE blocking readback
         except Exception as e:
             # async dispatch defers device execution errors to this
             # readback — tag it so the recovery layer (resilience.device)
-            # recognizes a device-plane failure whatever its type
+            # recognizes a device-plane failure whatever its type.  The
+            # completion callbacks still fire (with an error event and
+            # no timings) so bounded consumers — the jax.profiler
+            # capture's dispatch budget — cannot leak on faults.
+            if done_cbs is not None:
+                event = {
+                    "error": True,
+                    "n_requests": len(requests),
+                    "compiled": compiled,
+                }
+                for cb in done_cbs:
+                    try:
+                        cb(event)
+                    except Exception:
+                        pass
             from ..resilience.device import mark_device_error
 
             raise mark_device_error(e)
+        if done_cbs is not None:
+            t_read1 = time.perf_counter()
+            # host-observed timings: exact on the sync paths (resolve
+            # follows the launch immediately); a late resolver (the
+            # speculative engine) reports its overlap as wait_s and its
+            # busy estimate as launch + readback only
+            wait_s = max(t_read0 - t_launch1, 0.0)
+            event = {
+                "n_requests": len(requests),
+                "compiled": compiled,
+                "launch_s": t_launch1 - t_launch0,
+                "wait_s": wait_s,
+                "readback_s": t_read1 - t_read0,
+                "device_s": (
+                    (t_read1 - t_launch0) if wait_s < 0.005
+                    else (t_launch1 - t_launch0) + (t_read1 - t_read0)
+                ),
+                "out_bytes": int(flat.nbytes),
+            }
+            for cb in done_cbs:
+                cb(event)  # observer callbacks must not raise
         outs, off = [], 0
         for kind, args, st in requests:
             L, k = args[0].shape[0], st["k"]

@@ -87,6 +87,11 @@ RACE_LINT_FILES = (
     # shared Trace objects, and concurrent finishes serialize the log
     # append — span buffers and log-writer state carry guards
     os.path.join(_PKG_ROOT, "tracing.py"),
+    # device performance observability: resolver callbacks record
+    # dispatches from scheduler/driver threads while /metrics renders —
+    # the profiler's cost cache and the capture's trace state carry
+    # guards
+    os.path.join(_PKG_ROOT, "profiling.py"),
 )
 
 

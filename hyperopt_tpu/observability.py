@@ -549,6 +549,246 @@ class ServiceStats:
         )
 
 
+class DeviceStats:
+    """Per-dispatch device-plane accounting for the roofline profiler
+    (:mod:`hyperopt_tpu.profiling`).
+
+    Every fused suggest dispatch an installed
+    :class:`~hyperopt_tpu.profiling.DeviceProfiler` observes lands here
+    as one record: host-observed device seconds, modeled FLOPs and HBM
+    bytes, achieved TFLOP/s and GB/s, and the roofline attribution —
+    WHICH ceiling binds the program (HBM bandwidth vs peak FLOP/s) and
+    what fraction of that binding ceiling it achieved.  Aggregates:
+
+    - **duty cycle** — device-busy seconds over wall seconds since this
+      stats object started (host-observed dispatch->resolve intervals;
+      exact on the sync/service paths, an upper bound under
+      speculative overlap);
+    - **binding-ceiling histogram** — dispatch counts per ceiling, the
+      one-line answer to "is this workload bandwidth- or compute-
+      bound";
+    - **memory watermarks** — the high-water of live program bytes
+      (inputs + output of a dispatch) and, when the backend reports
+      one, its peak allocated bytes;
+    - a bounded **per-signature table** (the DEVICE_PROFILE.json
+      roofline table): per fused-program signature, dispatch count,
+      mean device time, cost, and mean/last roofline attribution.
+
+    Thread-safe: resolver callbacks record from scheduler/driver
+    threads while ``/metrics`` renders concurrently.
+    """
+
+    MAX_SIGNATURES = 128
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t_started = time.monotonic()
+        self._n_dispatches = 0  # guarded-by: _lock
+        self._n_requests = 0  # guarded-by: _lock
+        self._busy_s = 0.0  # guarded-by: _lock
+        self._launch_s = 0.0  # guarded-by: _lock
+        self._readback_s = 0.0  # guarded-by: _lock
+        self._flops_total = 0.0  # guarded-by: _lock
+        self._bytes_total = 0.0  # guarded-by: _lock
+        self._n_compiled = 0  # guarded-by: _lock
+        self._ceiling_counts = defaultdict(int)  # guarded-by: _lock
+        # roofline-percent aggregation over STEADY-STATE dispatches only
+        # (a record tagged ``compiled`` timed an XLA compile inside its
+        # interval — real cost, meaningless throughput)
+        self._pct_sum = defaultdict(float)  # guarded-by: _lock
+        self._pct_n = defaultdict(int)  # guarded-by: _lock
+        self._live_bytes_hw = 0  # guarded-by: _lock
+        self._backend_peak_bytes = None  # guarded-by: _lock
+        self._sigs = {}  # guarded-by: _lock
+        self._sig_drops = 0  # guarded-by: _lock
+        self._last = None  # guarded-by: _lock
+
+    def record_dispatch(self, rec: dict):
+        """One completed fused dispatch (record shape documented in
+        :meth:`hyperopt_tpu.profiling.DeviceProfiler._observe`)."""
+        device_s = float(rec.get("device_s") or 0.0)
+        ceiling = rec.get("binding_ceiling")
+        pct = rec.get("roofline_pct")
+        live = int(rec.get("live_bytes") or 0)
+        compiled = bool(rec.get("compiled"))
+        with self._lock:
+            self._n_dispatches += 1
+            self._n_requests += int(rec.get("n_requests") or 1)
+            self._n_compiled += int(compiled)
+            self._busy_s += device_s
+            self._launch_s += float(rec.get("launch_s") or 0.0)
+            self._readback_s += float(rec.get("readback_s") or 0.0)
+            self._flops_total += float(rec.get("flops") or 0.0)
+            self._bytes_total += float(rec.get("hbm_bytes") or 0.0)
+            if ceiling is not None:
+                # the ceiling classification is pure arithmetic
+                # intensity — timing-independent, so compiled
+                # dispatches count here too
+                self._ceiling_counts[str(ceiling)] += 1
+                if pct is not None and not compiled:
+                    self._pct_sum[str(ceiling)] += float(pct)
+                    self._pct_n[str(ceiling)] += 1
+            if live > self._live_bytes_hw:
+                self._live_bytes_hw = live
+            self._last = dict(rec)
+            sig = str(rec.get("sig", "?"))
+            agg = self._sigs.get(sig)
+            if agg is None:
+                if len(self._sigs) >= self.MAX_SIGNATURES:
+                    self._sig_drops += 1
+                    return
+                agg = self._sigs[sig] = {
+                    "n": 0, "n_requests": 0, "n_compiled": 0,
+                    "steady_s": 0.0, "n_steady": 0, "any_s": 0.0,
+                    "pct_sum": 0.0, "ceilings": defaultdict(int),
+                    "last": None, "last_any": None,
+                }
+            agg["n"] += 1
+            agg["n_requests"] += int(rec.get("n_requests") or 1)
+            agg["n_compiled"] += int(compiled)
+            agg["any_s"] += device_s
+            agg["last_any"] = dict(rec)
+            if not compiled:
+                agg["steady_s"] += device_s
+                agg["n_steady"] += 1
+                if pct is not None:
+                    agg["pct_sum"] += float(pct)
+                agg["last"] = dict(rec)
+            if ceiling is not None:
+                agg["ceilings"][str(ceiling)] += 1
+
+    def set_backend_peak_bytes(self, nbytes):
+        """Record the backend allocator's peak (``Device.memory_stats()
+        ['peak_bytes_in_use']`` where available — TPU yes, CPU no)."""
+        if nbytes is None:
+            return
+        with self._lock:
+            if (
+                self._backend_peak_bytes is None
+                or nbytes > self._backend_peak_bytes
+            ):
+                self._backend_peak_bytes = int(nbytes)
+
+    @property
+    def n_dispatches(self) -> int:
+        with self._lock:
+            return self._n_dispatches
+
+    def last_record(self):
+        with self._lock:
+            return dict(self._last) if self._last is not None else None
+
+    def duty_cycle(self):
+        """Device-busy fraction of wall time since this object started
+        (None before the first dispatch); clamped at 1.0 — overlapping
+        host-observed intervals cannot mean >100% busy."""
+        with self._lock:
+            busy = self._busy_s
+            n = self._n_dispatches
+        if not n:
+            return None
+        elapsed = time.monotonic() - self._t_started
+        return min(busy / elapsed, 1.0) if elapsed > 0 else None
+
+    def ceiling_counts(self) -> dict:
+        with self._lock:
+            return dict(sorted(self._ceiling_counts.items()))
+
+    def mean_roofline_pct(self) -> dict:
+        """{ceiling: mean roofline_pct over the STEADY-STATE dispatches
+        it bound} (compile-carrying dispatches excluded)."""
+        with self._lock:
+            return {
+                c: self._pct_sum[c] / n
+                for c, n in sorted(self._pct_n.items())
+                if n
+            }
+
+    def signature_table(self) -> list:
+        """The per-signature roofline table, most-dispatched first.
+        Rows prefer steady-state records; a signature whose only
+        dispatches carried a compile falls back to those (flagged by
+        ``steady: false``) — either way every row reports a non-null
+        binding ceiling and roofline_pct (the DEVICE_PROFILE
+        acceptance gate)."""
+        with self._lock:
+            rows = []
+            for sig, agg in self._sigs.items():
+                steady = agg["n_steady"] > 0
+                last = (agg["last"] if steady else agg["last_any"]) or {}
+                mean_s = (
+                    agg["steady_s"] / agg["n_steady"] if steady
+                    else agg["any_s"] / max(agg["n"], 1)
+                )
+                rows.append({
+                    "sig": sig,
+                    "n_dispatches": agg["n"],
+                    "n_compile_dispatches": agg["n_compiled"],
+                    "n_requests": agg["n_requests"],
+                    "steady": steady,
+                    "device_ms_mean": round(mean_s * 1e3, 4),
+                    "flops_per_dispatch": last.get("flops"),
+                    "mxu_flops_per_dispatch": last.get("mxu_flops"),
+                    "hbm_bytes_per_dispatch": last.get("hbm_bytes"),
+                    "ai_flops_per_byte": last.get("ai_flops_per_byte"),
+                    "achieved_tflops": last.get("achieved_tflops"),
+                    "achieved_GBps": last.get("achieved_GBps"),
+                    "binding_ceiling": last.get("binding_ceiling"),
+                    "roofline_pct": last.get("roofline_pct"),
+                    "roofline_pct_mean": round(
+                        agg["pct_sum"] / agg["n_steady"], 4
+                    ) if steady else last.get("roofline_pct"),
+                    "ceilings": dict(sorted(agg["ceilings"].items())),
+                    "cost_source": last.get("cost_source"),
+                })
+        rows.sort(key=lambda r: -r["n_dispatches"])
+        return rows
+
+    def summary(self) -> dict:
+        duty = self.duty_cycle()
+        pct = self.mean_roofline_pct()
+        table = self.signature_table()
+        with self._lock:
+            return {
+                "n_dispatches": self._n_dispatches,
+                "n_requests": self._n_requests,
+                "n_compile_dispatches": self._n_compiled,
+                "busy_s": round(self._busy_s, 6),
+                "launch_s": round(self._launch_s, 6),
+                "readback_s": round(self._readback_s, 6),
+                "duty_cycle": round(duty, 6) if duty is not None else None,
+                "flops_total": self._flops_total,
+                "hbm_bytes_total": self._bytes_total,
+                "binding_ceiling_counts": dict(
+                    sorted(self._ceiling_counts.items())
+                ),
+                "roofline_pct_mean": {
+                    k: round(v, 4) for k, v in pct.items()
+                },
+                "memory": {
+                    "live_buffer_highwater_bytes": self._live_bytes_hw,
+                    "backend_peak_bytes": self._backend_peak_bytes,
+                },
+                "signatures": table,
+                "signature_drops": self._sig_drops,
+            }
+
+    def log_summary(self, level=logging.INFO):
+        s = self.summary()
+        if not s["n_dispatches"]:
+            return
+        logger.log(
+            level,
+            "device: dispatches=%d duty=%s GB=%.3f ceilings=%s "
+            "roofline_pct=%s",
+            s["n_dispatches"],
+            s["duty_cycle"],
+            s["hbm_bytes_total"] / 1e9,
+            s["binding_ceiling_counts"],
+            s["roofline_pct_mean"],
+        )
+
+
 # ---------------------------------------------------------------------
 # Prometheus text exposition
 # ---------------------------------------------------------------------
@@ -574,6 +814,7 @@ def render_prometheus(
     speculation: "SpeculationStats" = None,
     faults: "FaultStats" = None,
     service: "ServiceStats" = None,
+    device: "DeviceStats" = None,
     extra: dict = None,
     namespace: str = "hyperopt",
 ):
@@ -712,6 +953,50 @@ def render_prometheus(
                 {"quantile": q_name},
                 s["suggest_latency"][q_key],
             )
+
+    if device is not None:
+        s = device.summary()
+        head("device_dispatches_total",
+             "Fused device programs observed by the roofline profiler.",
+             "counter")
+        sample("device_dispatches_total", None, s["n_dispatches"])
+        head("device_busy_seconds_total",
+             "Host-observed device-busy seconds (dispatch to resolve).",
+             "counter")
+        sample("device_busy_seconds_total", None, s["busy_s"])
+        head("device_duty_cycle",
+             "Device-busy fraction of wall time since stats start.",
+             "gauge")
+        sample("device_duty_cycle", None, s["duty_cycle"])
+        head("device_hbm_bytes_total",
+             "Modeled HBM bytes moved by observed dispatches.", "counter")
+        sample("device_hbm_bytes_total", None, s["hbm_bytes_total"])
+        head("device_flops_total",
+             "Modeled FLOPs executed by observed dispatches.", "counter")
+        sample("device_flops_total", None, s["flops_total"])
+        head("device_binding_dispatches_total",
+             "Dispatches per binding roofline ceiling "
+             "(hbm_bw = bandwidth-bound, flops = compute-bound).",
+             "counter")
+        for ceiling, n in s["binding_ceiling_counts"].items():
+            sample("device_binding_dispatches_total",
+                   {"ceiling": ceiling}, n)
+        head("device_roofline_pct",
+             "Mean achieved fraction (percent) of the BINDING ceiling, "
+             "per ceiling, over the dispatches it bound.", "gauge")
+        for ceiling, pct in s["roofline_pct_mean"].items():
+            sample("device_roofline_pct", {"ceiling": ceiling}, pct)
+        head("device_memory_highwater_bytes",
+             "Memory high-water: live program buffers (inputs+output of "
+             "one dispatch) and backend allocator peak when reported.",
+             "gauge")
+        mem = s["memory"]
+        sample("device_memory_highwater_bytes",
+               {"kind": "live_buffers"},
+               mem["live_buffer_highwater_bytes"])
+        if mem["backend_peak_bytes"] is not None:
+            sample("device_memory_highwater_bytes",
+                   {"kind": "backend_peak"}, mem["backend_peak_bytes"])
 
     if extra:
         for key, value in sorted(extra.items()):

@@ -95,6 +95,37 @@ def effective_scorer(scorer: str, k_total: int) -> str:
     return scorer
 
 
+def pair_score_cost(n_cand: int, k_total: int, scorer: str) -> dict:
+    """{flops, mxu_flops, bytes} model of one pair-scorer invocation at
+    C candidates x K total mixture components — the memory-behavior
+    knowledge lives here because it differs per implementation:
+
+    - both scorers: the rank-3 matmul is 2*3*C*K FLOPs (``mxu_flops`` —
+      the subset MFU is defined against) and the two logsumexps add
+      ~4 FLOPs/cell (max pass, subtract, exp, add);
+    - the **XLA** scorer materializes the [C, K] component matrix
+      (chunked, but each chunk round-trips when [chunk, K] exceeds
+      VMEM — the measured PALLAS_MIN_K crossover above is exactly that
+      spill), so its traffic model charges a write + read of the full
+      matrix: at production K this makes it **bandwidth-bound**;
+    - the **Pallas** kernels accumulate the logsumexp online in VMEM
+      and never materialize comp: traffic is just candidates, params,
+      and output.
+
+    ``hyperopt_tpu.profiling`` uses this for its analytical per-family
+    cost fallback; the XLA model is an upper bound XLA's fusion may
+    beat at small K (where the chunk fits in cache/VMEM).
+    """
+    C, K = float(n_cand), float(k_total)
+    mxu = 2.0 * 3.0 * C * K
+    flops = mxu + 4.0 * C * K
+    # z read + features + output, params [3, K]
+    nbytes = 4.0 * (3.0 * C + 3.0 * K)
+    if effective_scorer(scorer, int(k_total)) != "pallas":
+        nbytes += 2.0 * C * K * 4.0  # comp matrix write + read
+    return {"flops": flops, "mxu_flops": mxu, "bytes": nbytes}
+
+
 def _features(z):
     return jnp.stack([z * z, z, jnp.ones_like(z)], axis=1)  # [C, 3]
 

@@ -1,0 +1,591 @@
+"""Roofline-aware device performance observability.
+
+``BENCH_TPU.json`` quotes 1.44% MFU against the MXU bf16 peak — a number
+that *sounds* like a 70x kernel-speed bug, but the EI scorer is a
+logsumexp-dominated kernel whose XLA form materializes an O(C x K)
+component matrix: at production shapes it can be **bandwidth-bound**, in
+which case the MXU peak is the wrong ceiling and the right question is
+"what fraction of HBM bandwidth does it achieve?".  Nobody could answer
+that, because no layer measured bytes moved.  This module is that layer:
+
+- a per-program **cost model**: FLOPs *and* bytes-moved for every fused
+  suggest program signature, from XLA's own
+  ``jit(...).lower(...).compile().cost_analysis()`` when available
+  (:func:`xla_cost`) and from an analytical per-family model otherwise
+  (:func:`analytical_cost` — the always-on default: it is arithmetic on
+  shapes, never a second compile on the serving path);
+- **roofline attribution** (:func:`roofline`): arithmetic intensity vs
+  the ridge point decides which ceiling *binds* each dispatch — HBM
+  bandwidth or peak FLOP/s — and ``roofline_pct`` is the fraction of
+  that *binding* ceiling achieved, so "1.44% MFU" becomes either "3% of
+  a roofline it is far from" or "80% of the bandwidth bound it is at";
+- a :class:`DeviceProfiler` observer hooked on
+  ``tpe_device._suggest_observers``: every dispatch records device
+  time, achieved GB/s, achieved TFLOP/s, binding ceiling, roofline_pct,
+  and live-buffer bytes into an
+  :class:`~hyperopt_tpu.observability.DeviceStats` (exported as
+  Prometheus gauges on the service ``/metrics``, attached as attrs on
+  the tracing layer's ``device.dispatch`` spans);
+- an opt-in bounded :class:`ProfileCapture` around ``jax.profiler``
+  (``--profile-dir``, N dispatches) for TensorBoard/Perfetto deep
+  dives.
+
+Timing caveat (same as bench.py): device intervals are host-observed
+(launch -> blocking readback).  On the synchronous suggest and service
+paths the readback is immediate so the interval is tight; a speculative
+dispatch whose resolver is called late reports the wait separately
+(``wait_s``) and its busy time as launch + readback only.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+logger = logging.getLogger(__name__)
+
+# ---------------------------------------------------------------------
+# Hardware ceilings
+# ---------------------------------------------------------------------
+
+# v5e: 197 TFLOP/s bf16 MXU peak (bench.py reports MFU against this,
+# i.e. conservatively low for the f32 paths) and 819 GB/s HBM bandwidth.
+TPU_PEAK_TFLOPS = 197.0
+TPU_PEAK_HBM_GBPS = 819.0
+
+# Nominal single-socket CPU ceilings so CPU-mode artifacts (the CI
+# smoke's DEVICE_PROFILE.json) still carry self-consistent, NON-NULL
+# roofline attribution.  Order-of-magnitude placeholders, flagged by
+# ``source: "nominal_cpu"`` — never compare absolute CPU roofline_pct
+# against a TPU capture.
+CPU_PEAK_TFLOPS = 0.2
+CPU_PEAK_DRAM_GBPS = 25.0
+
+
+def platform_peaks(platform: str) -> dict:
+    """The {peak_tflops, peak_hbm_GBps, ridge_ai, source} ceiling set
+    for ``platform`` ("tpu"/"cpu"/...).  Env overrides
+    ``HYPEROPT_TPU_PEAK_TFLOPS`` / ``HYPEROPT_TPU_PEAK_HBM_GBPS`` pin
+    other chip generations without a code change.
+
+    ``ridge_ai`` is the roofline ridge point in FLOPs/byte: programs
+    below it cannot reach the FLOP peak no matter how good the kernel —
+    HBM bandwidth binds them.
+    """
+    if platform == "tpu":
+        peak_tflops, peak_bw = TPU_PEAK_TFLOPS, TPU_PEAK_HBM_GBPS
+        source = "tpu_v5e_datasheet"
+    else:
+        peak_tflops, peak_bw = CPU_PEAK_TFLOPS, CPU_PEAK_DRAM_GBPS
+        source = f"nominal_{platform}"
+    env_f = os.environ.get("HYPEROPT_TPU_PEAK_TFLOPS")
+    env_b = os.environ.get("HYPEROPT_TPU_PEAK_HBM_GBPS")
+    if env_f:
+        peak_tflops, source = float(env_f), "env_override"
+    if env_b:
+        peak_bw, source = float(env_b), "env_override"
+    return {
+        "peak_tflops": peak_tflops,
+        "peak_hbm_GBps": peak_bw,
+        "ridge_ai": (peak_tflops * 1e12) / (peak_bw * 1e9),
+        "source": source,
+    }
+
+
+def roofline(flops: float, bytes_moved: float, device_s: float,
+             peaks: dict) -> dict:
+    """Attribute one program execution to the roofline ceiling that
+    binds it.
+
+    Arithmetic intensity ``AI = flops / bytes`` below the ridge point
+    means the program's attainable FLOP/s is ``AI * peak_BW`` — HBM
+    bandwidth is the binding ceiling and ``roofline_pct`` is achieved
+    GB/s over peak GB/s (identically: achieved FLOP/s over attainable
+    FLOP/s).  At or above the ridge the FLOP peak binds and
+    ``roofline_pct`` is achieved TFLOP/s over peak TFLOP/s.  Both
+    per-ceiling percentages are always reported so the table never
+    hides the non-binding axis.
+    """
+    flops = max(float(flops), 0.0)
+    bytes_moved = max(float(bytes_moved), 0.0)
+    if device_s <= 0.0 or (flops == 0.0 and bytes_moved == 0.0):
+        return {
+            "achieved_tflops": None, "achieved_GBps": None,
+            "ai_flops_per_byte": None, "ridge_ai": peaks["ridge_ai"],
+            "binding_ceiling": None, "roofline_pct": None,
+            "roofline_pct_mxu": None, "roofline_pct_bw": None,
+        }
+    achieved_tflops = flops / device_s / 1e12
+    achieved_gbps = bytes_moved / device_s / 1e9
+    pct_mxu = 100.0 * achieved_tflops / peaks["peak_tflops"]
+    pct_bw = 100.0 * achieved_gbps / peaks["peak_hbm_GBps"]
+    ai = flops / bytes_moved if bytes_moved else float("inf")
+    binding = "hbm_bw" if ai < peaks["ridge_ai"] else "flops"
+    return {
+        "achieved_tflops": achieved_tflops,
+        "achieved_GBps": achieved_gbps,
+        "ai_flops_per_byte": None if ai == float("inf") else ai,
+        "ridge_ai": peaks["ridge_ai"],
+        "binding_ceiling": binding,
+        "roofline_pct": pct_bw if binding == "hbm_bw" else pct_mxu,
+        "roofline_pct_mxu": pct_mxu,
+        "roofline_pct_bw": pct_bw,
+    }
+
+
+# ---------------------------------------------------------------------
+# Cost model: FLOPs and bytes per fused suggest program
+# ---------------------------------------------------------------------
+
+_F32 = 4  # every device buffer in the suggest plane is f32/i32
+
+
+def _cont_request_cost(args, statics) -> dict:
+    """Analytical (flops, bytes) for one continuous-family request —
+    the per-family extension of ``bench._scorer_flops`` that also
+    counts HBM traffic.  Terms below ~1% of the totals at production
+    shapes (prior uploads, argmax, counts) are deliberately dropped."""
+    from .ops.score import pair_score_cost
+
+    obs = args[1]
+    losses = args[4]
+    L, cap = int(obs.shape[0]), int(obs.shape[1])
+    capt = int(losses.shape[0])
+    k = int(statics["k"])
+    n_cand = int(statics["n_cand"])
+    cap_b = int(statics["cap_b"])
+    C = k * n_cand
+    K = (cap_b + 1) + (cap + 1)
+    quantized = bool(statics.get("quantized"))
+    n_buckets = int(statics.get("n_buckets", 0) or 0)
+
+    # split/fit/draw: ranks argsort over [CAPT] (shared by the family),
+    # per-label pack argsorts over [cap], Parzen fits ~O(cap), and the
+    # truncated-GMM draw ~O(C) — all linear-ish terms
+    flops = 16.0 * capt + L * (32.0 * cap + 12.0 * C)
+    # input residency: obs+pos [L,cap] x2, losses+keep+ranks [CAPT]
+    bytes_moved = 2.0 * L * cap * _F32 + 3.0 * capt * _F32
+    # candidates: written by the draw, re-read by the scorer
+    bytes_moved += 2.0 * L * C * _F32
+    mxu_flops = 0.0
+    if quantized and n_buckets > 0:
+        # bucket-grid scoring: exact quantized lpdf on a [B] grid per
+        # side (erf-based CDF, ~30 flops/cell), then an O(C) gather
+        flops += L * (2.0 * 30.0 * n_buckets * K + 4.0 * C)
+        bytes_moved += L * (2.0 * n_buckets * K * _F32 + C * _F32)
+    elif quantized or statics.get("scorer") == "exact":
+        # per-candidate exact lpdf: [C, K] erf broadcast per side
+        flops += L * 2.0 * 30.0 * C * K
+        bytes_moved += L * 2.0 * C * K * _F32
+    else:
+        sc = pair_score_cost(C, K, statics.get("scorer", "xla"))
+        flops += L * sc["flops"]
+        bytes_moved += L * sc["bytes"]
+        mxu_flops = L * sc["mxu_flops"]
+    # winners out
+    bytes_moved += L * k * _F32
+    return {"flops": flops, "bytes": bytes_moved, "mxu_flops": mxu_flops}
+
+
+def _idx_request_cost(args, statics) -> dict:
+    """Analytical (flops, bytes) for one index-family request."""
+    obs = args[1]
+    losses = args[4]
+    prior_p = args[8]
+    L, cap = int(obs.shape[0]), int(obs.shape[1])
+    capt = int(losses.shape[0])
+    U = int(prior_p.shape[1])
+    C = int(statics["k"]) * int(statics["n_cand"])
+    # posterior scatter-add over [cap] per side + [U] normalize, then a
+    # C-candidate draw and two O(C) categorical lpdf gathers
+    flops = 16.0 * capt + L * (2.0 * (4.0 * cap + 6.0 * U) + 10.0 * C)
+    bytes_moved = (
+        2.0 * L * cap * _F32 + 3.0 * capt * _F32
+        + 2.0 * L * U * _F32 + 3.0 * L * C * _F32
+        + L * int(statics["k"]) * _F32
+    )
+    return {"flops": flops, "bytes": bytes_moved, "mxu_flops": 0.0}
+
+
+def analytical_cost(requests) -> dict:
+    """{flops, bytes, mxu_flops, source} for one fused multi-family
+    request list — pure shape arithmetic (microseconds; safe on every
+    dispatch).  ``mxu_flops`` is the matmul-only subset MFU is defined
+    against (``bench._scorer_flops`` semantics)."""
+    total = {"flops": 0.0, "bytes": 0.0, "mxu_flops": 0.0}
+    for kind, args, statics in requests:
+        one = (
+            _cont_request_cost(args, statics) if kind == "cont"
+            else _idx_request_cost(args, statics)
+        )
+        for key in total:
+            total[key] += one[key]
+    total["source"] = "analytical"
+    return total
+
+
+def xla_cost(requests) -> dict:
+    """{flops, bytes, source} for the fused program of ``requests``
+    from XLA's own ``cost_analysis()`` — compiles a fresh copy of the
+    program (seconds), so this belongs in reports and tests, never on
+    the dispatch path.  Returns ``None`` when the backend does not
+    expose a cost analysis."""
+    import jax
+
+    from .algos import tpe_device
+
+    _, run = tpe_device._build_multi_run(requests)
+    compiled = jax.jit(run).lower(
+        [args for _, args, _ in requests]
+    ).compile()
+    try:
+        analyses = compiled.cost_analysis()
+    except Exception:  # backend without cost analysis
+        return None
+    if analyses is None:
+        return None
+    if isinstance(analyses, dict):
+        analyses = [analyses]
+    flops = sum(float(a.get("flops", 0.0)) for a in analyses)
+    bytes_moved = sum(
+        float(a.get("bytes accessed", 0.0)) for a in analyses
+    )
+    if flops <= 0.0 and bytes_moved <= 0.0:
+        return None
+    return {"flops": flops, "bytes": bytes_moved, "source": "xla"}
+
+
+def signature_key(requests) -> str:
+    """A human-readable stable key for one fused program signature —
+    the row key of the DEVICE_PROFILE.json roofline table and of the
+    profiler's cost cache.  Carries the same (trial-bucket, families)
+    identity as ``tpe_device.compile_key`` plus every shape/static the
+    cost model branches on (``cap_b``, scorer choice, quantization
+    grid, mesh) — two programs whose costs can differ must never share
+    a key, or the first-seen cost would misattribute the other's
+    roofline."""
+    parts = []
+    capt = 0
+    for kind, args, statics in requests:
+        obs = args[1]
+        losses = args[4]
+        capt = max(capt, int(losses.shape[0]))
+        bits = [
+            f"L{int(obs.shape[0])}", f"cap{int(obs.shape[1])}",
+            f"capb{int(statics['cap_b'])}",
+            f"k{int(statics['k'])}", f"c{int(statics['n_cand'])}",
+        ]
+        if kind == "cont":
+            bits.append(str(statics.get("scorer", "?")))
+            if statics.get("quantized"):
+                bits.append(f"q{int(statics.get('n_buckets', 0) or 0)}")
+            if statics.get("log_scale"):
+                bits.append("log")
+            if statics.get("mesh") is not None:
+                bits.append("mesh")
+        else:
+            bits.append(f"u{int(statics.get('upper', 0) or 0)}")
+        parts.append(f"{kind}[{','.join(bits)}]")
+    return f"capt{capt}:" + "+".join(parts)
+
+
+# ---------------------------------------------------------------------
+# The dispatch observer
+# ---------------------------------------------------------------------
+
+_tls = threading.local()
+
+
+def last_dispatch_record(consume: bool = True):
+    """The most recent dispatch record produced ON THIS THREAD by an
+    installed :class:`DeviceProfiler` (None when none).  The service
+    scheduler reads it right after the fused readback — the resolver
+    ran on the same thread — to attach roofline attrs to the
+    ``device.dispatch`` spans.  ``consume`` clears it so a later batch
+    can never be attributed with a stale record."""
+    rec = getattr(_tls, "last_record", None)
+    if consume:
+        _tls.last_record = None
+    return rec
+
+
+class DeviceProfiler:
+    """The per-dispatch roofline observer.
+
+    ``install()`` registers on ``tpe_device._suggest_observers``; for
+    every fused dispatch it computes the program's cost (cached per
+    signature — the steady state is one dict lookup) and returns a
+    completion callback the resolver fires with host-observed timings.
+    Each completed dispatch becomes one record in ``stats``
+    (:class:`~hyperopt_tpu.observability.DeviceStats`) and this
+    thread's :func:`last_dispatch_record`.
+
+    Overhead contract: *not installed* means ``_suggest_observers``
+    stays empty and the dispatch path pays one truthiness check
+    (device_report.py's overhead section measures the installed cost
+    too — acceptance: suggest p50 within 5%).
+    """
+
+    def __init__(self, stats=None, peaks=None, keep_samples=False):
+        from .observability import DeviceStats
+
+        self.stats = stats if stats is not None else DeviceStats()
+        self._peaks = peaks
+        self.keep_samples = bool(keep_samples)
+        self._lock = threading.Lock()
+        self._cost_cache = {}  # guarded-by: _lock  (sig_key -> cost dict)
+        self._samples = {}  # guarded-by: _lock  (sig_key -> requests)
+        self._installed = None
+        # disarmed after the first failure: CPU's memory_stats() is
+        # None and some backends raise — probe once, not per dispatch
+        self._backend_mem = True
+
+    @property
+    def peaks(self) -> dict:
+        # resolved lazily so constructing a profiler never initializes
+        # the jax backend
+        if self._peaks is None:
+            import jax
+
+            self._peaks = platform_peaks(jax.default_backend())
+        return self._peaks
+
+    def install(self):
+        if self._installed is not None:
+            return self
+        from .algos import tpe_device
+
+        tpe_device._suggest_observers.append(self._observe)
+        self._installed = self._observe
+        return self
+
+    def uninstall(self):
+        if self._installed is None:
+            return
+        from .algos import tpe_device
+
+        try:
+            tpe_device._suggest_observers.remove(self._installed)
+        except ValueError:
+            pass
+        self._installed = None
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    def sample_requests(self, sig_key: str):
+        """The retained request list for ``sig_key`` (requires
+        ``keep_samples=True``) — device_report.py re-lowers it for the
+        per-signature ``cost_analysis()`` cross-check."""
+        with self._lock:
+            return self._samples.get(sig_key)
+
+    def signature_keys(self):
+        with self._lock:
+            return sorted(self._samples)
+
+    # -- the observer --------------------------------------------------
+    def _observe(self, requests):
+        """Fires host-side once per fused dispatch, BEFORE the launch.
+        Returns the completion callback the resolver invokes with the
+        timing event — must never raise (profiling cannot fail a
+        suggest)."""
+        try:
+            sig_key = signature_key(requests)
+            with self._lock:
+                cost = self._cost_cache.get(sig_key)
+            if cost is None:
+                cost = analytical_cost(requests)
+                with self._lock:
+                    self._cost_cache[sig_key] = cost
+                    if self.keep_samples:
+                        self._samples[sig_key] = requests
+            # live-buffer residency of this program: every device array
+            # it reads (nbytes is shape metadata — no transfer)
+            arg_bytes = 0
+            for _, args, _ in requests:
+                for a in args:
+                    arg_bytes += int(getattr(a, "nbytes", 0))
+            peaks = self.peaks
+            stats = self.stats
+        except Exception:
+            logger.warning("device profiler observe failed", exc_info=True)
+            return None
+
+        def _on_complete(event):
+            try:
+                if event.get("error"):
+                    return  # failed readback: no timings to attribute
+                device_s = float(event["device_s"])
+                roof = roofline(cost["flops"], cost["bytes"], device_s,
+                                peaks)
+                rec = {
+                    "sig": sig_key,
+                    "n_requests": int(event.get("n_requests", 1)),
+                    "device_s": device_s,
+                    "launch_s": float(event.get("launch_s", 0.0)),
+                    "wait_s": float(event.get("wait_s", 0.0)),
+                    "readback_s": float(event.get("readback_s", 0.0)),
+                    "flops": cost["flops"],
+                    "mxu_flops": cost["mxu_flops"],
+                    "hbm_bytes": cost["bytes"],
+                    "live_bytes": arg_bytes + int(event.get("out_bytes", 0)),
+                    "cost_source": cost["source"],
+                    "compiled": bool(event.get("compiled", False)),
+                }
+                if self._backend_mem:
+                    try:
+                        import jax
+
+                        mem = jax.devices()[0].memory_stats()
+                        if mem:
+                            stats.set_backend_peak_bytes(
+                                mem.get("peak_bytes_in_use")
+                            )
+                        else:
+                            self._backend_mem = False
+                    except Exception:
+                        self._backend_mem = False
+                rec.update(roof)
+                stats.record_dispatch(rec)
+                _tls.last_record = rec
+            except Exception:
+                logger.warning(
+                    "device profiler record failed", exc_info=True
+                )
+
+        return _on_complete
+
+
+# ---------------------------------------------------------------------
+# Bounded jax.profiler capture
+# ---------------------------------------------------------------------
+
+
+class ProfileCapture:
+    """Opt-in ``jax.profiler`` capture of the first N fused dispatches.
+
+    The service CLI's ``--profile-dir`` hook: starts a profiler trace
+    at the first dispatch after :meth:`install` and stops it once
+    ``max_dispatches`` have *resolved*, so the capture holds complete
+    device programs and is bounded however long the server lives.
+    View with TensorBoard/Perfetto.  Never raises into the dispatch
+    path; a backend without profiler support logs once and disarms.
+    """
+
+    # lock-order: _lock
+    def __init__(self, log_dir, max_dispatches: int = 16):
+        self.log_dir = str(log_dir)
+        self.max_dispatches = int(max_dispatches)
+        self._lock = threading.Lock()
+        self._started = False  # guarded-by: _lock
+        self._stopped = False  # guarded-by: _lock
+        self._n_seen = 0  # guarded-by: _lock
+        self._n_resolved = 0  # guarded-by: _lock
+        self._installed = None
+
+    def install(self):
+        if self._installed is not None or self.max_dispatches <= 0:
+            return self
+        from .algos import tpe_device
+
+        tpe_device._suggest_observers.append(self._observe)
+        self._installed = self._observe
+        return self
+
+    def uninstall(self):
+        if self._installed is not None:
+            from .algos import tpe_device
+
+            try:
+                tpe_device._suggest_observers.remove(self._installed)
+            except ValueError:
+                pass
+            self._installed = None
+        self._stop()
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "log_dir": self.log_dir,
+                "max_dispatches": self.max_dispatches,
+                "started": self._started,
+                "stopped": self._stopped,
+                "n_captured": min(self._n_resolved, self.max_dispatches),
+            }
+
+    def _start(self):
+        import jax
+
+        try:
+            jax.profiler.start_trace(self.log_dir)
+            return True
+        except Exception:
+            logger.warning(
+                "jax.profiler capture unavailable; disarming",
+                exc_info=True,
+            )
+            return False
+
+    def _stop(self):
+        with self._lock:
+            if not self._started or self._stopped:
+                self._stopped = True
+                return
+            self._stopped = True
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+            logger.info(
+                "device profile captured to %s (%d dispatches)",
+                self.log_dir, self.max_dispatches,
+            )
+        except Exception:
+            logger.warning("jax.profiler stop failed", exc_info=True)
+
+    def _observe(self, requests):
+        with self._lock:
+            if self._stopped:
+                return None
+            if self._n_seen >= self.max_dispatches:
+                past_budget = True
+            else:
+                past_budget = False
+                self._n_seen += 1
+            need_start = not past_budget and not self._started
+            if need_start:
+                self._started = True
+        if past_budget:
+            # backstop: a budgeted dispatch whose resolver never ran (a
+            # discarded speculation) must not leave the trace open for
+            # the server's lifetime — the first dispatch past budget
+            # closes it
+            self._stop()
+            return None
+        if need_start and not self._start():
+            with self._lock:
+                self._stopped = True
+            return None
+
+        def _on_complete(event):
+            # error events count too: a failed readback consumed budget
+            with self._lock:
+                self._n_resolved += 1
+                done = self._n_resolved >= self.max_dispatches
+            if done:
+                self._stop()
+
+        return _on_complete

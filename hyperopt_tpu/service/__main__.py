@@ -90,6 +90,17 @@ def make_parser():
              "is set and tracing is enabled)",
     )
     p.add_argument(
+        "--profile-dir", default=None, dest="profile_dir",
+        help="capture a jax.profiler device trace of the first "
+             "--profile-dispatches fused dispatches into this directory "
+             "(view with TensorBoard/Perfetto); bounded, then disarms",
+    )
+    p.add_argument(
+        "--profile-dispatches", type=int, default=16,
+        dest="profile_dispatches",
+        help="how many fused dispatches --profile-dir captures",
+    )
+    p.add_argument(
         "--chaos-config", default=None, dest="chaos_config",
         help="TESTING ONLY: JSON ChaosConfig activating seeded "
              "service-plane fault injection (torn writes, connection "
@@ -156,6 +167,18 @@ def main(argv=None):
         max_studies=options.max_studies,
         tracer=tracer,
     )
+    capture = None
+    if options.profile_dir:
+        from ..profiling import ProfileCapture
+
+        capture = ProfileCapture(
+            options.profile_dir,
+            max_dispatches=options.profile_dispatches,
+        ).install()
+        logger.info(
+            "device profile capture armed: first %d dispatches -> %s",
+            options.profile_dispatches, options.profile_dir,
+        )
     server = ServiceServer(service, host=options.host, port=options.port)
     logger.info(
         "optimization service listening on %s (root=%s, window=%.1fms, "
@@ -189,11 +212,17 @@ def main(argv=None):
                 server.serve_forever()
         except KeyboardInterrupt:
             server.stop()
+        finally:
+            if capture is not None:
+                capture.uninstall()
         return 0
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         server.stop()
+    finally:
+        if capture is not None:
+            capture.uninstall()
     return 0
 
 

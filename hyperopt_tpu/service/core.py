@@ -70,7 +70,12 @@ from ..base import (
     Domain,
     Trials,
 )
-from ..observability import FaultStats, PhaseTimings, ServiceStats
+from ..observability import (
+    DeviceStats,
+    FaultStats,
+    PhaseTimings,
+    ServiceStats,
+)
 from ..utils import coarse_utcnow
 
 logger = logging.getLogger(__name__)
@@ -100,6 +105,11 @@ def _active_chaos():
     from ..parallel.file_trials import _active_chaos as impl
 
     return impl()
+
+
+def _r4(v):
+    """round(v, 4) passing None through (nullable roofline attrs)."""
+    return None if v is None else round(float(v), 4)
 
 
 def canonical_json(payload) -> bytes:
@@ -1230,6 +1240,25 @@ class SuggestScheduler:
         self.stats.record_dispatch(n_batch, time.perf_counter() - t0)
         self.stats.record_phase("dispatch", t_launch1 - t_launch0)
         self.stats.record_phase("readback", t_read1 - t_launch1)
+        # roofline attribution of THIS dispatch: the device profiler's
+        # resolver callback ran on this thread during the readback
+        # above, so its record (consumed — a later batch can never read
+        # a stale one) is exactly this fused program's
+        from .. import profiling
+
+        roof = profiling.last_dispatch_record()
+        roof_attrs = {}
+        if roof is not None:
+            roof_attrs = {
+                "ceiling": roof["binding_ceiling"],
+                "roofline_pct": _r4(roof["roofline_pct"]),
+                "roofline_pct_bw": _r4(roof["roofline_pct_bw"]),
+                "achieved_GBps": _r4(roof["achieved_GBps"]),
+                "achieved_tflops": _r4(roof["achieved_tflops"]),
+                "hbm_bytes": roof["hbm_bytes"],
+                "flops": roof["flops"],
+                "compiled": roof["compiled"],
+            }
         # fan the shared device spans out to EVERY traced request in the
         # batch: the span interval is the real (shared) wall interval,
         # and pro_rata_s attributes this request's 1/n share — summing
@@ -1243,11 +1272,12 @@ class SuggestScheduler:
                     "batch.peer_wait", t_prep1, t_launch0,
                     parent=p.parent_span, stage="prepare",
                 )
-            p.trace.record_span(
+            sp = p.trace.record_span(
                 "device.dispatch", t_launch0, t_launch1,
                 parent=p.parent_span, batch_size=n_batch, shared=True,
                 pro_rata_s=round((t_launch1 - t_launch0) / n_batch, 9),
             )
+            sp.update_attrs(roof_attrs)
             p.trace.record_span(
                 "device.readback", t_launch1, t_read1,
                 parent=p.parent_span, batch_size=n_batch, shared=True,
@@ -1332,6 +1362,15 @@ class OptimizationService:
         from ..resilience.device import DeviceRecovery
 
         self.device_recovery = DeviceRecovery(stats=self.fault_stats)
+        # device performance observability: a roofline profiler records
+        # every fused dispatch (device time, achieved GB/s and TFLOP/s,
+        # binding ceiling, memory watermarks) into device_stats —
+        # exported on /metrics and attached to device.dispatch spans
+        self.device_stats = DeviceStats()
+        from ..profiling import DeviceProfiler
+
+        self.device_profiler = DeviceProfiler(stats=self.device_stats)
+        self.device_profiler.install()
         # compile attribution: a tpe_device trace-time observer turns
         # every XLA retrace of the fused suggest program into a counted
         # (trial-bucket, family) event AND a span on the trace that paid
@@ -1636,6 +1675,7 @@ class OptimizationService:
             "draining": self._closed,
             "stats": self.stats.summary(),
             "faults": self.fault_stats.summary(),
+            "device": self.device_stats.summary(),
             "recovery": dict(self.registry.recovery_info),
             "fsck": self.fsck_report,
             "tracing": self.tracer.summary(),
@@ -1671,6 +1711,7 @@ class OptimizationService:
             timings=self.timings,
             faults=self.fault_stats,
             service=self.stats,
+            device=self.device_stats,
             extra={"service_uptime_seconds": time.time() - self.started_at},
         )
 
@@ -1686,3 +1727,4 @@ class OptimizationService:
         self._closed = True
         self.scheduler.close(timeout=timeout)
         self._uninstall_compile_observer()
+        self.device_profiler.uninstall()

@@ -94,6 +94,17 @@ class Span:
             self.attrs = {}
         self.attrs[key] = value
 
+    def update_attrs(self, mapping):
+        """Bulk attribute attach (e.g. the roofline attrs the scheduler
+        adds to a ``device.dispatch`` span after the profiler's record
+        lands).  ``None`` values are kept — a null roofline field is
+        information (the cost model declined to attribute)."""
+        if not mapping:
+            return
+        if self.attrs is None:
+            self.attrs = {}
+        self.attrs.update(mapping)
+
     @property
     def duration_s(self):
         return None if self.t1 is None else self.t1 - self.t0
@@ -113,6 +124,9 @@ class _NullSpan:
         return False
 
     def set_attr(self, key, value):
+        pass
+
+    def update_attrs(self, mapping):
         pass
 
     name = None

@@ -26,13 +26,39 @@ def _load(path):
         return json.load(f)
 
 
+def _assert_null_with_reason_contract(d):
+    """The VERDICT r6 #4 contract: a device-plane headline field is
+    either measured (non-null, reason null) or null WITH a reason —
+    never a silent 0.0 placeholder."""
+    rate_fields = (
+        "value", "vs_baseline", "achieved_tflops", "achieved_GBps",
+        "roofline_pct", "roofline_pct_bw", "binding_ceiling",
+    )
+    for key in rate_fields:
+        assert d.get(key) != 0.0, f"{key} is a 0.0 placeholder"
+    if d["achieved_tflops"] is None:
+        assert d.get("unmeasured_reason"), (
+            "null achieved_tflops requires unmeasured_reason"
+        )
+    else:
+        assert d.get("unmeasured_reason") is None
+        assert d["binding_ceiling"] in ("hbm_bw", "flops")
+        assert d["achieved_GBps"] > 0
+        assert d["roofline_pct"] > 0 and d["roofline_pct_bw"] > 0
+    if d["mfu_pct"] is None:
+        assert d.get("unmeasured_reason") or d.get("mfu_pct_reason")
+
+
 @needs_tpu_json
 def test_headline_artifact_is_hardware_and_beats_north_star():
     d = _load(TPU)
     assert d["platform"] == "tpu"
     # BASELINE.md north star: >=1000x the CPU reference's EI-eval rate
     assert d["vs_baseline"] >= 1000.0, d["vs_baseline"]
+    # measured capture: roofline attribution present and non-null
+    _assert_null_with_reason_contract(d)
     assert d["mfu_pct"] is not None
+    assert d["peaks"]["peak_hbm_GBps"] > 0
     # full scorer A/B on record: xla + both pallas modes at both
     # candidate counts and both history sizes
     ab = d["scorer_ab"]
@@ -127,6 +153,21 @@ def test_trace_serve_artifact_attributes_the_tail():
 @pytest.mark.skipif(
     not os.path.exists(TPU_100K), reason="no committed 100k artifact"
 )
+def test_100k_headline_nulls_carry_a_reason():
+    """The re-stamped 100k artifact: its device rate was unavailable at
+    capture, so every rate-derived field must be null WITH a reason —
+    the original 0.0 placeholders (VERDICT r6 #4) must never return."""
+    d = _load(TPU_100K)
+    _assert_null_with_reason_contract(d)
+    assert d["value"] is None and d["vs_baseline"] is None
+    assert d["achieved_tflops"] is None and d["mfu_pct"] is None
+    assert "unavailable" in d["unmeasured_reason"]
+
+
+@needs_tpu_json
+@pytest.mark.skipif(
+    not os.path.exists(TPU_100K), reason="no committed 100k artifact"
+)
 def test_host_traffic_flat_from_10k_to_100k_history():
     d10, d100 = _load(TPU), _load(TPU_100K)
     assert d100["platform"] == "tpu"
@@ -141,3 +182,46 @@ def test_host_traffic_flat_from_10k_to_100k_history():
         d100["suggests_per_sec_driver_loop"]
         > 0.8 * d10["suggests_per_sec_driver_loop"]
     )
+
+
+DEVICE_PROFILE = os.path.join(ROOT, "DEVICE_PROFILE.json")
+
+
+@pytest.mark.skipif(
+    not os.path.exists(DEVICE_PROFILE),
+    reason="no committed device-profile artifact",
+)
+def test_device_profile_artifact_fully_attributed():
+    """The ISSUE-7 acceptance artifact: a per-signature roofline table
+    where EVERY dispatch reports a non-null binding ceiling and
+    roofline_pct, a ceiling histogram that accounts for every dispatch,
+    duty cycle and memory watermarks, and an observer-overhead check
+    within 5%."""
+    d = _load(DEVICE_PROFILE)
+    assert d["metric"] == "device_profile"
+    assert d["ok"] is True
+    # the committed artifact is the FULL capture (a quick smoke writes
+    # DEVICE_PROFILE.quick.json and must never clobber this one)
+    assert d["quick"] is False
+    assert d["overhead"] is not None
+    assert d["n_dispatches"] >= 10
+    assert d["unattributed_dispatches"] == 0
+    # the ceiling histogram accounts for every dispatch
+    assert sum(d["binding_ceiling_hist"].values()) == d["n_dispatches"]
+    assert d["signatures"]
+    for row in d["signatures"]:
+        assert row["binding_ceiling"] in ("hbm_bw", "flops"), row
+        assert row["roofline_pct"] is not None and row["roofline_pct"] > 0
+        assert row["achieved_GBps"] is not None
+        assert row["hbm_bytes_per_dispatch"] > 0
+        assert row["flops_per_dispatch"] > 0
+        assert row["ai_flops_per_byte"] > 0
+    assert 0 < d["duty_cycle"] <= 1.0
+    assert d["memory"]["live_buffer_highwater_bytes"] > 0
+    assert d["peaks"]["peak_hbm_GBps"] > 0
+    # XLA's own cost analysis cross-checks the analytical model on at
+    # least one profiled signature
+    assert any("xla" in row for row in d["signatures"])
+    # observers-disabled overhead: suggest p50 within 5%
+    if d.get("overhead"):
+        assert d["overhead"]["p50_regression_frac"] < 0.05
