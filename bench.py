@@ -706,6 +706,46 @@ def trace_section(argv):
     return 0 if trep["ok"] else 1
 
 
+def study_health_section(argv):
+    """``python bench.py --study-health [--quick]``: search-health
+    observability smoke — runs the SH5xx study report
+    (scripts/study_report.py) on CPU and writes ``STUDY_HEALTH.json``
+    (healthy QUALITY.md domains all OK, one seeded degenerate fixture
+    per rule flagged with its intended id, the zero-extra-dispatch
+    assertion over the fused EI statistics, and the host-side overhead
+    check <5%).  A quick run writes a separate file so CI can never
+    clobber the committed full artifact (the PR 7 convention).  Prints
+    ONE JSON line like the other bench sections."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    study_report = _import_script("study_report")
+    quick = "--quick" in argv
+    out_path = "STUDY_HEALTH.quick.json" if quick else "STUDY_HEALTH.json"
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    t0 = time.time()
+    report = study_report.run_report(quick=quick)
+    study_report.write_report(report, out_path)
+    out = {
+        "metric": "study_health_smoke",
+        "value": sum(
+            1 for v in report["fixtures"].values() if v["ok"]
+        ),
+        "unit": "fixtures_flagged",
+        "ok": report["ok"],
+        "healthy_states": {
+            k: v["state"] for k, v in report["healthy"].items()
+        },
+        "extra_dispatches": report["zero_dispatch"]["extra_dispatches"],
+        "overhead_p50_regression_frac": (
+            report["overhead"]["p50_regression_frac"]
+            if report["overhead"] else None
+        ),
+        "elapsed_s": round(time.time() - t0, 2),
+    }
+    print(json.dumps(out))
+    return 0 if report["ok"] else 1
+
+
 def device_profile_section(argv):
     """``python bench.py --device-profile [--quick]``: device-plane
     observability smoke — runs the roofline-profiled suggest workload
@@ -750,6 +790,9 @@ def device_profile_section(argv):
 
 
 def main():
+    if "--study-health" in sys.argv[1:]:
+        argv = [a for a in sys.argv[1:] if a != "--study-health"]
+        return study_health_section(argv)
     if "--device-profile" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--device-profile"]
         return device_profile_section(argv)

@@ -52,7 +52,7 @@ from .fmin import (
     space_eval,
 )
 from .algos import anneal, atpe, criteria, mix, rand, tpe
-from .early_stop import no_progress_loss
+from .early_stop import no_progress_loss, no_progress_stop
 from .parallel import FileTrials, JaxTrials
 
 
@@ -126,6 +126,7 @@ __all__ = [
     "hp",
     "mix",
     "no_progress_loss",
+    "no_progress_stop",
     "partial",
     "pyll",
     "rand",

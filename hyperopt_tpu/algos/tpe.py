@@ -652,7 +652,7 @@ def _suggest_device(
                 ),
             ))
         req_fams.append(fam)
-    def finish_outs(outs):
+    def finish_outs(outs, diag=None):
         chosen_vals = {}
         for fam, best in zip(req_fams, outs):
             best = np.asarray(best)  # [L, k]
@@ -660,7 +660,29 @@ def _suggest_device(
                 if lb not in hard:
                     chosen_vals[lb] = fam.from_fit_space(i, best[i])
         chosen_vals.update(hard)
-        return _emit_docs(new_ids, domain, trials, chosen_vals, k)
+        docs = _emit_docs(new_ids, domain, trials, chosen_vals, k)
+        if diag is not None:
+            from .. import diagnostics as sdiag
+
+            if sdiag.enabled():
+                # search-health telemetry: the per-label EI/Parzen rows
+                # that rode the fused readback, published on this thread
+                # for the driver / service scheduler to consume
+                # (diagnostics.last_suggest_diag) — never touches docs.
+                # Published AFTER the doc build succeeds: a finish that
+                # raises must leave nothing in the thread-local for an
+                # unrelated later suggest to claim.
+                sdiag.publish_suggest_diag(sdiag.snapshot_from_fused(
+                    req_fams, diag,
+                    n_below=n_below, gamma=float(gamma), n_eff=int(n_eff),
+                    k=k, n_cand=int(n_EI_candidates),
+                ))
+        return docs
+
+    # the continuous-batching scheduler checks this before threading the
+    # batched dispatch's diag rows through (other algos' finish callables
+    # may not take the keyword)
+    finish_outs.accepts_diag = True
 
     if prepare:
         return requests, finish_outs
@@ -672,7 +694,8 @@ def _suggest_device(
     resolve_fetch = td.multi_family_suggest_async(requests)
 
     def finish():
-        return finish_outs(resolve_fetch())
+        outs = resolve_fetch()
+        return finish_outs(outs, diag=getattr(resolve_fetch, "diag", None))
 
     if defer:
         return finish

@@ -43,6 +43,7 @@ from functools import partial
 import jax
 import numpy as np
 
+from ..diagnostics import D_EI_TOP_K, DIAG_COLS
 from ..ops import gmm as gmm_ops
 from ..ops import parzen as parzen_ops
 
@@ -617,6 +618,55 @@ def _loss_ranks(losses, keep_mask):
     )
 
 
+def _ei_diag(score2):
+    """Per-label EI-landscape reductions over the full candidate set:
+    ``(max, log-mean-exp, top-k softmax mass)`` each ``[L]`` from the
+    ``[L, C]`` scores ALREADY live in registers — the search-health
+    telemetry rides the fused program for a few extra scalars of
+    output, zero extra dispatches (see hyperopt_tpu.diagnostics).
+
+    Scores are sanitized first: an out-of-support candidate's
+    ``log l − log g`` can be ±inf and their difference NaN, which must
+    not poison the reductions (the winner argmax is computed on the RAW
+    scores elsewhere — this never perturbs the suggestion)."""
+    import jax
+    import jax.numpy as jnp
+
+    C = score2.shape[1]
+    s = jnp.clip(
+        jnp.nan_to_num(score2, nan=-1e30, posinf=1e30, neginf=-1e30),
+        -1e30, 1e30,
+    )
+    smax = jnp.max(s, axis=1)
+    lse = jax.scipy.special.logsumexp(s, axis=1)
+    lme = lse - jnp.float32(np.log(C))
+    topk = jax.lax.top_k(s, min(D_EI_TOP_K, C))[0]
+    mass = jnp.sum(jnp.exp(topk - lse[:, None]), axis=1)
+    return smax, lme, mass
+
+
+def _sigma_diag(wb, sb, nbs, prior_sigma):
+    """Below-mixture sigma-spread reductions ``[L]`` over REAL
+    components (weight > 0: the nb observations + the prior): min and
+    mean sigma relative to the prior sigma, and the fraction of real
+    components clipped at the adaptive-Parzen floor
+    ``prior_sigma / min(100, nb + 2)`` — the SIGMA_COLLAPSE signal
+    (identical observations have zero neighbor gaps, so every
+    observation component lands on the floor)."""
+    import jax.numpy as jnp
+
+    ps = jnp.maximum(prior_sigma, EPS)
+    mask = wb > 0
+    n_comp = jnp.maximum(jnp.sum(mask, axis=1), 1).astype(jnp.float32)
+    floor = ps / jnp.minimum(100.0, 2.0 + nbs.astype(jnp.float32))
+    sig_min = jnp.min(jnp.where(mask, sb, jnp.inf), axis=1) / ps
+    sig_mean = jnp.sum(jnp.where(mask, sb, 0.0), axis=1) / n_comp / ps
+    floor_frac = (
+        jnp.sum(mask & (sb <= floor[:, None] * 1.001), axis=1) / n_comp
+    )
+    return sig_min, sig_mean, floor_frac
+
+
 def _family_suggest_core(
     keys,          # [L, 2] u32
     obs,           # [L, CAP] f32 fit-space
@@ -681,9 +731,9 @@ def _family_suggest_core(
             above, na, prior_weight, pm, ps, lf
         )
         cand = gmm_ops.gmm_sample(key, wb, mb, sb, lo, hi, qq, k * n_cand, log_scale)
-        return cand, (wb, mb, sb), (wa, ma, sa)
+        return cand, (wb, mb, sb), (wa, ma, sa), nb, na
 
-    cands, B, A = jax.vmap(fit_sample)(
+    cands, B, A, nbs, nas = jax.vmap(fit_sample)(
         keys, obs, pos, counts, priors, lock_center, lock_radius
     )
     lo, hi, qq = priors[:, 2], priors[:, 3], priors[:, 4]
@@ -730,10 +780,22 @@ def _family_suggest_core(
                 score = pair_score_pallas_batched(z, params, k_below)
             else:
                 score = jax.vmap(partial(pair_score, k_below=k_below))(z, params)
+    # search-health reductions on the scores/fits already in hand (a few
+    # scalars appended to the flat output; never touches the winner math)
+    ei_max, ei_lme, ei_mass = _ei_diag(score.reshape(L, k * n_cand))
+    sig_min, sig_mean, sig_floor = _sigma_diag(B[0], B[2], nbs, priors[:, 1])
+    diag = jnp.stack(
+        [
+            nbs.astype(jnp.float32), nas.astype(jnp.float32),
+            ei_max, ei_lme, ei_mass, sig_min, sig_mean, sig_floor,
+        ],
+        axis=1,
+    )  # [L, DIAG_COLS]
     score = score.reshape(L, k, n_cand)
     cands = cands.reshape(L, k, n_cand)
     idx = jnp.argmax(score, axis=2)  # [L, k]
-    return jnp.take_along_axis(cands, idx[:, :, None], axis=2)[:, :, 0]
+    win = jnp.take_along_axis(cands, idx[:, :, None], axis=2)[:, :, 0]
+    return win, diag
 
 
 def _sharded_pair_apply(mesh, z, params, k_below):
@@ -798,13 +860,45 @@ def _index_family_suggest_core(
         pa = jnp.where(pp > 0, pa, 0.0)
         cand = gmm_ops.categorical_sample(key, pb, k * n_cand)
         sc = gmm_ops.categorical_lpdf(cand, pb) - gmm_ops.categorical_lpdf(cand, pa)
-        return cand.reshape(k, n_cand), sc.reshape(k, n_cand)
+        # discrete-exhaustion signals: which categories the VALID
+        # observation set covers (invalid slots scatter weight 0, so a
+        # clipped padding index can never fake category 0 as observed)
+        iv = jnp.arange(obs_l.shape[0])
+        cat = jnp.clip(obs_l.astype(jnp.int32), 0, upper - 1)
+        cat_w = jnp.zeros(upper, jnp.float32).at[cat].add(
+            (iv < count_l).astype(jnp.float32)
+        )
+        present = cat_w > 0
+        return (
+            cand.reshape(k, n_cand), sc.reshape(k, n_cand),
+            present, jnp.sum(present), jnp.sum(pp > 0), nb, na,
+        )
 
-    cands, score = jax.vmap(one)(
+    cands, score, present, n_distinct, support, nbs, nas = jax.vmap(one)(
         keys, obs, pos, counts, prior_p, lock_center, lock_radius
     )
+    ei_max, ei_lme, ei_mass = _ei_diag(score.reshape(L, k * n_cand))
     idx = jnp.argmax(score, axis=2)
-    return jnp.take_along_axis(cands, idx[:, :, None], axis=2)[:, :, 0]
+    win = jnp.take_along_axis(cands, idx[:, :, None], axis=2)[:, :, 0]
+    # duplicate-argmax fraction: how many of the k winners re-draw an
+    # already-observed category (1.0 on every suggest of a space whose
+    # discrete support is exhausted)
+    dup_frac = jnp.mean(
+        jnp.take_along_axis(
+            present.astype(jnp.float32), jnp.clip(win, 0, upper - 1), axis=1
+        ),
+        axis=1,
+    )
+    diag = jnp.stack(
+        [
+            nbs.astype(jnp.float32), nas.astype(jnp.float32),
+            ei_max, ei_lme, ei_mass,
+            n_distinct.astype(jnp.float32), dup_frac,
+            support.astype(jnp.float32),
+        ],
+        axis=1,
+    )  # [L, DIAG_COLS]
+    return win, diag
 
 
 _jit_cache = {}
@@ -890,8 +984,17 @@ def _build_multi_run(requests):
             for obs in list(_trace_observers):
                 obs(sig, shapes)
         outs = [core(*a) for core, a in zip(cores, args_list)]
+        # per family: winners then the [L, DIAG_COLS] search-health row
+        # (see hyperopt_tpu.diagnostics) — one flat f32 output either way
         return jnp.concatenate(
-            [o.astype(jnp.float32).reshape(-1) for o in outs]
+            [
+                part
+                for win, diag in outs
+                for part in (
+                    win.astype(jnp.float32).reshape(-1),
+                    diag.astype(jnp.float32).reshape(-1),
+                )
+            ]
         )
 
     return sig, run
@@ -993,11 +1096,19 @@ def multi_family_suggest_async(requests):
             }
             for cb in done_cbs:
                 cb(event)  # observer callbacks must not raise
-        outs, off = [], 0
+        outs, diags, off = [], [], 0
         for kind, args, st in requests:
             L, k = args[0].shape[0], st["k"]
             outs.append(flat[off : off + L * k].reshape(L, k))
             off += L * k
+            diags.append(
+                flat[off : off + L * DIAG_COLS].reshape(L, DIAG_COLS)
+            )
+            off += L * DIAG_COLS
+        # the winner arrays ARE the return value (the stable contract);
+        # the search-health rows ride as a resolver attribute so the
+        # suggest finish can publish them without a second readback
+        resolve.diag = diags
         return outs
 
     return resolve
@@ -1052,9 +1163,18 @@ def multi_study_suggest_async(groups):
     for i in order:
         spans[i] = (off, off + len(groups[i]))
         off += len(groups[i])
-    return [
-        (lambda lo=lo, hi=hi: _outs()[lo:hi]) for lo, hi in spans
-    ]
+
+    def _group_resolver(lo, hi):
+        def resolve_group():
+            outs = _outs()
+            # slice this group's search-health rows off the shared
+            # resolver (available once the readback ran)
+            resolve_group.diag = resolve_all.diag[lo:hi]
+            return outs[lo:hi]
+
+        return resolve_group
+
+    return [_group_resolver(lo, hi) for lo, hi in spans]
 
 
 def multi_family_suggest(requests):

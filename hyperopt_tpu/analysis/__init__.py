@@ -92,6 +92,10 @@ RACE_LINT_FILES = (
     # the profiler's cost cache and the capture's trace state carry
     # guards
     os.path.join(_PKG_ROOT, "profiling.py"),
+    # search-health telemetry: the scheduler and report paths feed a
+    # study's SearchStats while /metrics and /v1/study_status snapshot
+    # it — every counter carries a guard
+    os.path.join(_PKG_ROOT, "diagnostics.py"),
 )
 
 

@@ -711,6 +711,7 @@ class Trials:
         max_speculation=None,
         retry_policy=None,
         fault_stats=None,
+        search_stats=None,
     ):
         """Minimize ``fn`` over ``space`` using this store (see ``fmin``)."""
         from .fmin import fmin as _fmin  # local import: avoid circularity
@@ -737,6 +738,7 @@ class Trials:
             max_speculation=max_speculation,
             retry_policy=retry_policy,
             fault_stats=fault_stats,
+            search_stats=search_stats,
         )
 
 

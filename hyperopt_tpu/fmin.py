@@ -120,6 +120,7 @@ class FMinIter:
         max_speculation=None,
         retry_policy=None,
         fault_stats=None,
+        search_stats=None,
     ):
         self.algo = algo
         self.domain = domain
@@ -161,6 +162,18 @@ class FMinIter:
         self.timings = PhaseTimings()
         self.speculation_stats = SpeculationStats()
         self.fault_stats = fault_stats if fault_stats is not None else FaultStats()
+        if search_stats is None:
+            from .diagnostics import SearchStats
+
+            # best-effort startup horizon: a partial-as-config algo
+            # (partial(tpe.suggest, n_startup_jobs=...)) declares it in
+            # its keywords; plain algos get the TPE default
+            n_startup = getattr(algo, "keywords", None) or {}
+            search_stats = SearchStats(
+                n_startup_jobs=int(n_startup.get("n_startup_jobs", 20)),
+                fault_stats=self.fault_stats,
+            )
+        self.search_stats = search_stats
         from .resilience.device import DeviceRecovery
 
         # wraps every suggest-program dispatch: XLA/TPU runtime errors
@@ -507,6 +520,15 @@ class FMinIter:
                                     new_ids, self.domain, trials, seed
                                 )
                             )
+                    # search-health telemetry: the fused readback's
+                    # EI/Parzen snapshot was published on this thread by
+                    # the suggest's finish (None on host-side/random
+                    # suggests) — fold it into the run's SearchStats
+                    from . import diagnostics as _search_diag
+
+                    self.search_stats.record_suggest(
+                        _search_diag.last_suggest_diag()
+                    )
                     if new_trials is None:
                         stopped = True
                         break
@@ -555,6 +577,9 @@ class FMinIter:
                             self.serial_evaluate()
 
                 self.trials.refresh()
+                # fold this round's completions (OK losses incl. NaN,
+                # error-state count) into the run's search health
+                self.search_stats.observe_trials(self.trials)
                 if self.trials_save_file != "":
                     if self._orbax_ckpt is not None:
                         self._orbax_ckpt.save(self.trials)
@@ -656,6 +681,7 @@ def fmin(
     validate_space=False,
     retry_policy=None,
     fault_stats=None,
+    search_stats=None,
 ):
     """Minimize ``fn`` over ``space`` — the reference's full signature.
 
@@ -703,6 +729,14 @@ def fmin(
     events into (pass one to aggregate driver + worker + chaos
     accounting across a campaign); by default the driver owns a private
     instance, exposed as ``FMinIter.fault_stats``.
+
+    ``search_stats``: a shared
+    :class:`~hyperopt_tpu.diagnostics.SearchStats` to accumulate
+    search-health telemetry into (running best / regret curve, fault
+    rates, and each fused suggest's EI/Parzen snapshot — the SH5xx
+    health classifier's input; see ``docs/observability.md``); by
+    default the driver owns a private instance, exposed as
+    ``FMinIter.search_stats``.
 
     ``validate_space=True`` runs the static space linter
     (:func:`hyperopt_tpu.analysis.lint_space`) before the first trial:
@@ -794,6 +828,7 @@ def fmin(
             max_speculation=max_speculation,
             retry_policy=retry_policy,
             fault_stats=fault_stats,
+            search_stats=search_stats,
         )
 
     if trials is None:
@@ -830,6 +865,7 @@ def fmin(
         max_speculation=max_speculation,
         retry_policy=retry_policy,
         fault_stats=fault_stats,
+        search_stats=search_stats,
     )
     rval.catch_eval_exceptions = catch_eval_exceptions
     try:

@@ -815,6 +815,7 @@ def render_prometheus(
     faults: "FaultStats" = None,
     service: "ServiceStats" = None,
     device: "DeviceStats" = None,
+    study_health: dict = None,
     extra: dict = None,
     namespace: str = "hyperopt",
 ):
@@ -826,6 +827,14 @@ def render_prometheus(
     Every argument is optional; only the sections passed render.
     ``extra`` is a flat ``{metric_suffix: scalar}`` dict rendered as
     gauges (for ad-hoc gauges like process uptime).
+
+    ``study_health``: ``{"rows": [...], "truncated_total": int}`` — the
+    per-study search-health gauge block.  Each row is one
+    :meth:`hyperopt_tpu.diagnostics.SearchStats.metrics_row` dict; the
+    CALLER bounds the row count (top-N studies by recency — see
+    ``OptimizationService.metrics_text``), and ``truncated_total``
+    counts the studies dropped by that bound so a million-study fleet
+    can never blow up the exposition unnoticed.
     """
     lines = []
 
@@ -997,6 +1006,44 @@ def render_prometheus(
         if mem["backend_peak_bytes"] is not None:
             sample("device_memory_highwater_bytes",
                    {"kind": "backend_peak"}, mem["backend_peak_bytes"])
+
+    if study_health is not None:
+        rows = study_health.get("rows", ())
+        gauges = (
+            ("study_best_loss", "best_loss",
+             "Best (lowest) finite reported loss per study."),
+            ("study_regret", "regret",
+             "Simple regret (best loss minus the known optimum) per "
+             "study; NaN when no optimum was declared."),
+            ("study_gamma", "gamma",
+             "TPE gamma quantile of the study's latest fused suggest."),
+            ("study_n_below", "n_below",
+             "Below-set size of the study's latest fused suggest."),
+            ("study_ei_max", "ei_max",
+             "Max EI log-ratio over candidates, latest fused suggest "
+             "(max over dimensions)."),
+            ("study_ei_flatness", "ei_flatness",
+             "EI landscape flatness (max minus log-mean-exp score; ~0 "
+             "means no candidate ranks above any other), mean over "
+             "dimensions."),
+        )
+        for metric, key, help_text in gauges:
+            head(metric, help_text, "gauge")
+            for row in rows:
+                sample(metric, {"study": row["study"]}, row.get(key))
+        head("study_health",
+             "Per-study SH5xx search-health verdict (1 on the current "
+             "state).", "gauge")
+        for row in rows:
+            sample(
+                "study_health",
+                {"study": row["study"], "state": row["state"]}, 1,
+            )
+        head("studies_truncated_total",
+             "Studies omitted from the per-study gauge families by the "
+             "cardinality bound (top-N by recency).", "counter")
+        sample("studies_truncated_total", None,
+               study_health.get("truncated_total", 0))
 
     if extra:
         for key, value in sorted(extra.items()):

@@ -128,6 +128,7 @@ class JaxTrials(Trials):
         max_speculation=None,
         retry_policy=None,
         fault_stats=None,
+        search_stats=None,
     ):
         from ..fmin import fmin as _fmin
 
@@ -187,6 +188,7 @@ class JaxTrials(Trials):
                 ),
                 retry_policy=retry_policy,
                 fault_stats=fault_stats,
+                search_stats=search_stats,
             )
         finally:
             state.stop()

@@ -59,6 +59,7 @@ from functools import partial
 
 import numpy as np
 
+from .. import diagnostics as search_diag
 from .. import tracing
 from ..base import (
     JOB_STATE_DONE,
@@ -95,6 +96,10 @@ DEFAULT_MAX_BATCH = 32
 DEFAULT_MAX_QUEUE = 64
 DEFAULT_MAX_STUDIES = 256
 DEFAULT_SUGGEST_TIMEOUT = 120.0
+# per-study /metrics gauge families export at most this many studies
+# (top-N by last search activity) — the cardinality guard that keeps a
+# million-study fleet from blowing up the Prometheus exposition
+DEFAULT_METRICS_MAX_STUDIES = 50
 
 _ALGOS = ("tpe", "rand", "anneal")
 
@@ -477,6 +482,24 @@ class Study:
         # of consuming a second seed)
         self.journal = ResponseJournal(path=self._journal_path())
         self._inflight = {}  # idempotency_key -> _PendingSuggest
+        # search-health telemetry: fed by the scheduler (fused-readback
+        # diag per suggest) and the report path (loss/error/NaN stream);
+        # internally locked — safe to read while self.lock is free
+        self.search_stats = search_diag.SearchStats(
+            study_id=self.study_id,
+            n_startup_jobs=int(self.algo_params.get("n_startup_jobs", 20)),
+        )
+        # recovered studies re-count their result stream so the health
+        # verdict survives a restart (the fused diag refreshes on the
+        # next suggest)
+        for doc in self.trials._dynamic_trials:
+            if doc["state"] == JOB_STATE_DONE:
+                self.search_stats.record_result(
+                    loss=doc.get("result", {}).get("loss"),
+                    status=doc.get("result", {}).get("status", "ok"),
+                )
+            elif doc["state"] == JOB_STATE_ERROR:
+                self.search_stats.record_result(status="fail")
 
     def _journal_path(self):
         if getattr(self.trials, "jobs", None) is None:
@@ -630,7 +653,12 @@ class Study:
         ):
             # NaN/inf losses would poison best-trial math and render
             # as invalid JSON (bare NaN) in status payloads — a
-            # diverged trial is a FAILED trial at this API
+            # diverged trial is a FAILED trial at this API.  The
+            # rejection still COUNTS for search health (a NaN storm
+            # must surface as FAULT_DEGRADED even though no state
+            # changed) — once per trial, so an idempotent client
+            # retrying the rejected report cannot inflate the counters
+            self.search_stats.record_nan_rejected(doc["tid"])
             raise ValueError(
                 f"non-finite loss {result['loss']!r} for trial {tid}; "
                 f"report status='fail' instead"
@@ -647,6 +675,9 @@ class Study:
         if self.durable:
             self.trials.jobs.write(doc)
         self.refresh_local()
+        self.search_stats.record_result(
+            loss=result.get("loss"), status=result.get("status", "ok")
+        )
         return doc
 
     def report(self, tid, loss=None, status=STATUS_OK, result=None,
@@ -728,6 +759,8 @@ class Study:
                 "tid": int(hist.loss_tids[i]),
                 "loss": float(hist.losses[i]),
             }
+        snap = self.search_stats.snapshot()
+        health = self.search_stats.health(snap=snap)
         return {
             "study_id": self.study_id,
             "seed": self.seed,
@@ -739,6 +772,28 @@ class Study:
             "n_suggests": self.n_seeds_drawn,
             "best": best,
             "durable": self.durable,
+            # operators correlate health verdicts with the resilience
+            # layer from this one document — no store reads required
+            "faults": snap["faults"],
+            "seed_cursor": {
+                "drawn": self.n_seeds_drawn,
+                "committed": self.n_seeds_committed,
+            },
+            # the search-health block: SH5xx verdict + the optimizer
+            # statistics it was derived from (latest fused suggest)
+            "health": {
+                "state": health["state"],
+                "rule": health["rule"],
+                "rules": health["rules"],
+                "best_loss": snap["best_loss"],
+                "regret": snap["regret"],
+                "improvement_window": snap["improvement_window"],
+                "stall_window": snap["stall_window"],
+                "n_results": snap["n_results"],
+                "n_startup_jobs": snap["n_startup_jobs"],
+                "regret_curve": snap["regret_curve"],
+                "last_suggest": snap["last_suggest"],
+            },
         }
 
 
@@ -902,6 +957,11 @@ class StudyRegistry:
     def list(self):
         with self._studies_lock:
             return sorted(self._studies)
+
+    def studies(self):
+        """Snapshot of the live Study objects (unordered)."""
+        with self._studies_lock:
+            return list(self._studies.values())
 
     def __len__(self):
         with self._studies_lock:
@@ -1211,6 +1271,9 @@ class SuggestScheduler:
             if prep is None:
                 self.stats.record_phase("inline", t_prep1 - (t_draw1 or t_prep0))
                 self.stats.record_inline()
+                # host-side suggests (startup/random) carry no fused
+                # diag; the count still feeds the study's health stats
+                study.search_stats.record_suggest(None)
                 self._complete(p, docs, payload=payload)
             else:
                 self.stats.record_phase("prepare", t_prep1 - (t_draw1 or t_prep0))
@@ -1235,6 +1298,9 @@ class SuggestScheduler:
             resolvers = tpe_device.multi_study_suggest_async(groups)
             t_launch1 = time.monotonic()
             outs = [r() for r in resolvers]  # ONE readback, first call
+        # each group's search-health rows rode that same readback
+        # (zero extra dispatches — see hyperopt_tpu.diagnostics)
+        diags = [getattr(r, "diag", None) for r in resolvers]
         t_read1 = time.monotonic()
         n_batch = len(finishes)
         self.stats.record_dispatch(n_batch, time.perf_counter() - t0)
@@ -1284,7 +1350,7 @@ class SuggestScheduler:
                 pro_rata_s=round((t_read1 - t_launch1) / n_batch, 9),
                 device_total_s=round(t_read1 - t_launch0, 9),
             )
-        for (p, finish, _t_prep1), o in zip(finishes, outs):
+        for (p, finish, _t_prep1), o, dg in zip(finishes, outs, diags):
             study = p.study
             t_f0 = time.monotonic()
             try:
@@ -1297,12 +1363,25 @@ class SuggestScheduler:
                         )
                     with tracing.span("suggest.finish"):
                         with study.lock:
-                            docs = finish(o)
+                            if dg is not None and getattr(
+                                finish, "accepts_diag", False
+                            ):
+                                docs = finish(o, diag=dg)
+                            else:
+                                docs = finish(o)
+                            # consume the snapshot finish published on
+                            # this thread IMMEDIATELY: a later commit
+                            # failure must not leave it to be claimed
+                            # by a batch-mate's suggest
+                            snap = search_diag.last_suggest_diag()
                             payload = study.commit_suggest(
                                 docs, p.draw_index,
                                 idempotency_key=p.idempotency_key,
                             )
             except Exception as e:
+                # defensive TLS clear: whatever a failed finish/commit
+                # left published must not be claimed by a batch-mate
+                search_diag.last_suggest_diag()
                 if is_device_error(e):
                     raise
                 logger.exception(
@@ -1310,6 +1389,8 @@ class SuggestScheduler:
                 )
                 self._fail(p, e)
                 continue
+            # fold it into the study's search-health accumulator
+            study.search_stats.record_suggest(snap)
             self.stats.record_phase("finish", time.monotonic() - t_f0)
             self._complete(p, docs, payload=payload)
 
@@ -1352,8 +1433,14 @@ class OptimizationService:
                  max_batch=DEFAULT_MAX_BATCH, max_queue=DEFAULT_MAX_QUEUE,
                  max_studies=DEFAULT_MAX_STUDIES,
                  suggest_timeout=DEFAULT_SUGGEST_TIMEOUT,
-                 fault_stats=None, startup_fsck=True, tracer=None):
+                 fault_stats=None, startup_fsck=True, tracer=None,
+                 metrics_max_studies=DEFAULT_METRICS_MAX_STUDIES):
         self.stats = ServiceStats()
+        # per-study /metrics cardinality bound (top-N by recency) +
+        # running count of studies the bound dropped from the exposition
+        self.metrics_max_studies = int(metrics_max_studies)
+        self._truncated_lock = threading.Lock()
+        self._studies_truncated_total = 0  # guarded-by: _truncated_lock
         self.timings = PhaseTimings()
         self.tracer = tracer if tracer is not None else tracing.DISABLED
         self.fault_stats = (
@@ -1617,6 +1704,12 @@ class OptimizationService:
             pending.wait(
                 self.suggest_timeout if timeout is None else timeout
             )
+            if trace is not None:
+                # the search-health verdict at serve time, on the same
+                # span operators already read latency/roofline from
+                h = study.search_stats.health()
+                root.set_attr("health", h["state"])
+                root.set_attr("health_rule", h["rule"])
             if (
                 trace is not None
                 and pending.trace is trace
@@ -1704,14 +1797,32 @@ class OptimizationService:
             "fsck": self.fsck_report,
         }
 
+    def _study_health_rows(self):
+        """The bounded per-study gauge rows: top-N studies by last
+        search activity.  Returns ``(rows, truncated_total)`` and
+        advances the truncation counter by however many studies this
+        render dropped."""
+        studies = self.registry.studies()
+        studies.sort(
+            key=lambda s: s.search_stats.last_activity, reverse=True
+        )
+        cut = studies[: self.metrics_max_studies]
+        dropped = len(studies) - len(cut)
+        with self._truncated_lock:
+            self._studies_truncated_total += dropped
+            total = self._studies_truncated_total
+        return [s.search_stats.metrics_row() for s in cut], total
+
     def metrics_text(self) -> str:
         from ..observability import render_prometheus
 
+        rows, truncated = self._study_health_rows()
         return render_prometheus(
             timings=self.timings,
             faults=self.fault_stats,
             service=self.stats,
             device=self.device_stats,
+            study_health={"rows": rows, "truncated_total": truncated},
             extra={"service_uptime_seconds": time.time() - self.started_at},
         )
 

@@ -1,0 +1,498 @@
+"""Search-health study report → ``STUDY_HEALTH.json``.
+
+Exercises the search-quality telemetry layer
+(:mod:`hyperopt_tpu.diagnostics`) end to end and commits the evidence:
+
+- **Healthy domains** — seeded TPE runs over the QUALITY.md zoo
+  domains, each fed into a :class:`SearchStats` (fused-readback EI/
+  Parzen snapshots + the loss stream); every one must verdict **OK**.
+  The stall window is set to the trial budget: STALLED is an operator
+  policy about *wasted* budget, and a study that converges inside its
+  budget is healthy (the STALLED fixture below proves the rule fires
+  when it should).
+- **Seeded degenerate fixtures** — one per SH5xx rule, each flagged
+  with its intended rule id: the warm-up boundary at ``n_startup_jobs``
+  (SH501), a plateaued objective (SH502), a below/above-indistinguishable
+  discrete space (SH503), a sigma-collapse history whose best trials
+  share one exact x (SH504), an exhausted 3-choice space (SH505), and a
+  NaN-storm objective (SH506).
+- **The zero-dispatch contract** — the EI statistics ride the existing
+  fused suggest readback: over M device-plane suggests, the
+  :class:`~hyperopt_tpu.profiling.DeviceProfiler` must count exactly M
+  dispatches, the PR-2 :class:`RecompilationAuditor` must stay within
+  its one-trace-per-(trial-bucket, family) budget, and every suggest
+  must have published a diag snapshot.
+- **Overhead** — suggest p50 with the host-side snapshot build enabled
+  vs disabled (``diagnostics.set_enabled``), interleaved rounds;
+  acceptance: within 5%.
+
+Run:  python scripts/study_report.py [--quick] [--out STUDY_HEALTH.json]
+CI:   python bench.py --study-health --quick
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+HEALTHY_DOMAINS = ("quadratic1", "branin", "gauss_wave2", "hartmann6")
+
+
+def _done_doc(tid, vals, loss):
+    from hyperopt_tpu.base import JOB_STATE_DONE, STATUS_OK
+
+    return {
+        "tid": tid, "spec": None,
+        "result": {"status": STATUS_OK, "loss": loss},
+        "misc": {
+            "tid": tid, "cmd": None,
+            "idxs": {k: [tid] for k in vals},
+            "vals": {k: [v] for k, v in vals.items()},
+        },
+        "state": JOB_STATE_DONE, "owner": None, "book_time": None,
+        "refresh_time": None, "exp_key": None,
+    }
+
+
+def _warm_trials(space, docs):
+    from hyperopt_tpu import Trials
+    from hyperopt_tpu.base import Domain
+
+    domain = Domain(lambda c: 0.0, space)
+    trials = Trials()
+    trials._insert_trial_docs(docs)
+    trials.refresh()
+    return domain, trials
+
+
+def _fmin_stats(obj, space, seed, max_evals, stats, **algo_kw):
+    """One seeded fmin run feeding ``stats`` (fused snapshots + loss
+    stream through the driver's search_stats wiring)."""
+    from functools import partial
+
+    import numpy as np
+
+    from hyperopt_tpu import Trials, fmin
+    from hyperopt_tpu.algos import tpe
+
+    trials = Trials()
+    fmin(
+        obj, space, algo=partial(tpe.suggest, **algo_kw),
+        max_evals=max_evals, trials=trials,
+        rstate=np.random.default_rng(seed), show_progressbar=False,
+        verbose=False, search_stats=stats,
+    )
+    return trials
+
+
+def _suggest_into(stats, domain, trials, seed, **kw):
+    """One direct device suggest; feeds the published snapshot and the
+    trials' loss stream into ``stats``."""
+    from hyperopt_tpu import diagnostics as sdiag
+    from hyperopt_tpu.algos import tpe
+
+    tpe.suggest([10_000], domain, trials, seed, **kw)
+    stats.record_suggest(sdiag.last_suggest_diag())
+    stats.observe_trials(trials)
+
+
+# ---------------------------------------------------------------------
+# fixtures (one per SH5xx rule, all seeded)
+# ---------------------------------------------------------------------
+
+
+def fixture_warmup(quick):
+    """SH501: one result short of n_startup_jobs."""
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.diagnostics import SearchStats
+
+    stats = SearchStats(n_startup_jobs=20)
+    _fmin_stats(
+        lambda c: float(c["x"] ** 2), {"x": hp.uniform("x", -5, 5)},
+        seed=5, max_evals=19, stats=stats,
+    )
+    boundary = SearchStats(n_startup_jobs=20)
+    _fmin_stats(
+        lambda c: float(c["x"] ** 2), {"x": hp.uniform("x", -5, 5)},
+        seed=5, max_evals=25, stats=boundary, n_startup_jobs=20,
+        n_EI_candidates=64,
+    )
+    return stats, {"past_boundary_state": boundary.health()["state"]}
+
+
+def fixture_stalled(quick):
+    """SH502: an objective with a hard floor — best plateaus at 2.0."""
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.diagnostics import SearchStats
+
+    stats = SearchStats(n_startup_jobs=10, stall_window=15)
+    _fmin_stats(
+        lambda c: max(abs(c["x"]), 2.0), {"x": hp.uniform("x", -5, 5)},
+        seed=1, max_evals=30 if quick else 50, stats=stats,
+        n_startup_jobs=10, n_EI_candidates=64,
+    )
+    return stats, {}
+
+
+def fixture_flat_ei(quick):
+    """SH503: a 6-choice space where below and above carry identical
+    category evidence (only 3 categories ever observed, interleaved), so
+    l(x)/g(x) rank nothing — and the space is NOT exhausted (3 of 6
+    categories unseen), so no higher rule can own the verdict."""
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.diagnostics import SearchStats
+
+    space = {"c": hp.choice("c", list(range(6)))}
+    docs = [_done_doc(i, {"c": i % 3}, float(i % 2)) for i in range(40)]
+    domain, trials = _warm_trials(space, docs)
+    stats = SearchStats(n_startup_jobs=10, stall_window=40)
+    # gamma 3.2 puts ~half the history below: equal below/above counts
+    # per category is what makes the posteriors (hence EI) flat
+    _suggest_into(
+        stats, domain, trials, seed=11,
+        n_startup_jobs=10, n_EI_candidates=64, gamma=3.2,
+    )
+    return stats, {}
+
+
+def fixture_sigma_collapse(quick):
+    """SH504: the 12 best trials share one exact x — every below-set
+    neighbor gap is zero, so the adaptive-Parzen fit clips every
+    observation component to the sigma floor."""
+    import numpy as np
+
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.diagnostics import SearchStats
+
+    rng = np.random.default_rng(0)
+    space = {"x": hp.uniform("x", 0.0, 1.0)}
+    docs = []
+    for i in range(100):
+        if i < 12:
+            docs.append(_done_doc(i, {"x": 0.5}, 0.0))
+        else:
+            docs.append(_done_doc(
+                i, {"x": float(rng.uniform(0, 1))},
+                1.0 + float(rng.random()),
+            ))
+    domain, trials = _warm_trials(space, docs)
+    stats = SearchStats(n_startup_jobs=10, stall_window=200)
+    _suggest_into(
+        stats, domain, trials, seed=9,
+        n_startup_jobs=10, n_EI_candidates=64, gamma=1.0,
+    )
+    return stats, {}
+
+
+def fixture_exhausted(quick):
+    """SH505: a 3-choice space driven well past its 3 configurations —
+    every category observed, every EI argmax a duplicate."""
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.diagnostics import SearchStats
+
+    stats = SearchStats(n_startup_jobs=8, stall_window=200)
+    _fmin_stats(
+        lambda c: float(c["c"]), {"c": hp.choice("c", [0.0, 1.0, 2.0])},
+        seed=4, max_evals=20 if quick else 30, stats=stats,
+        n_startup_jobs=8, n_EI_candidates=64,
+    )
+    return stats, {}
+
+
+def fixture_nan_storm(quick):
+    """SH506: the objective diverges (NaN loss) on most trials past the
+    first few — the below set is starved while suggests stay fast."""
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.diagnostics import SearchStats
+
+    cnt = {"n": 0}
+
+    def nan_storm(c):
+        cnt["n"] += 1
+        return float("nan") if cnt["n"] > 5 else float(c["x"] ** 2)
+
+    stats = SearchStats(n_startup_jobs=10, stall_window=200)
+    _fmin_stats(
+        nan_storm, {"x": hp.uniform("x", -5, 5)},
+        seed=3, max_evals=20 if quick else 30, stats=stats,
+        n_startup_jobs=10, n_EI_candidates=64,
+    )
+    return stats, {}
+
+
+FIXTURES = (
+    ("warmup_boundary", "SH501", fixture_warmup),
+    ("stalled_plateau", "SH502", fixture_stalled),
+    ("flat_ei_indistinct_choice", "SH503", fixture_flat_ei),
+    ("sigma_collapse_identical_best", "SH504", fixture_sigma_collapse),
+    ("exhausted_3_choice", "SH505", fixture_exhausted),
+    ("nan_storm_objective", "SH506", fixture_nan_storm),
+)
+
+
+# ---------------------------------------------------------------------
+# the zero-dispatch + overhead sections
+# ---------------------------------------------------------------------
+
+
+def zero_dispatch_check(quick):
+    """The EI statistics must add ZERO device dispatches: M suggests →
+    exactly M profiled dispatches, recompiles within the one-trace
+    budget, and a published diag snapshot per suggest."""
+    import numpy as np
+
+    from hyperopt_tpu import diagnostics as sdiag
+    from hyperopt_tpu import hp, profiling
+    from hyperopt_tpu.algos import tpe
+    from hyperopt_tpu.analysis import RecompilationAuditor
+    from hyperopt_tpu.observability import DeviceStats
+
+    rng = np.random.default_rng(0)
+    space = {
+        "x": hp.uniform("x", -5, 5),
+        "lr": hp.loguniform("lr", -5, 0),
+        "c": hp.choice("c", ["a", "b", "d"]),
+    }
+    docs = [
+        _done_doc(i, {
+            "x": float(rng.uniform(-5, 5)),
+            "lr": float(np.exp(rng.uniform(-5, 0))),
+            "c": int(rng.integers(3)),
+        }, float(rng.normal()))
+        for i in range(60)
+    ]
+    domain, trials = _warm_trials(space, docs)
+    n_suggests = 6 if quick else 12
+    stats = DeviceStats()
+    n_snapshots = 0
+    with profiling.DeviceProfiler(stats=stats):
+        with RecompilationAuditor() as auditor:
+            # warm outside the count? No: the auditor budget covers the
+            # single compile too; dispatch counting starts fresh below
+            for i in range(n_suggests):
+                tpe.suggest(
+                    [1000 + i], domain, trials, i, n_startup_jobs=10,
+                    n_EI_candidates=128, verbose=False,
+                )
+                if sdiag.last_suggest_diag() is not None:
+                    n_snapshots += 1
+    retrace_violations = [
+        key for key, n in auditor.trace_counts.items() if n > 1
+    ]
+    return {
+        "n_suggests": n_suggests,
+        "n_dispatches": stats.n_dispatches,
+        "extra_dispatches": stats.n_dispatches - n_suggests,
+        "n_diag_snapshots": n_snapshots,
+        "recompile_trace_counts": {
+            str(bucket): n for bucket, n in auditor.bucket_summary()
+        },
+        "retrace_violations": [str(v) for v in retrace_violations],
+        "ok": (
+            stats.n_dispatches == n_suggests
+            and n_snapshots == n_suggests
+            and not retrace_violations
+        ),
+    }
+
+
+def measure_overhead(quick, n=12, rounds=3):
+    """Suggest p50 with the host-side snapshot build on vs off,
+    interleaved rounds (median of per-round regressions)."""
+    import numpy as np
+
+    from hyperopt_tpu import diagnostics as sdiag
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.algos import tpe
+
+    rng = np.random.default_rng(1)
+    space = {
+        "x": hp.uniform("x", -5, 5),
+        "lr": hp.loguniform("lr", -5, 0),
+        "c": hp.choice("c", ["a", "b", "d"]),
+    }
+    docs = [
+        _done_doc(i, {
+            "x": float(rng.uniform(-5, 5)),
+            "lr": float(np.exp(rng.uniform(-5, 0))),
+            "c": int(rng.integers(3)),
+        }, float(rng.normal()))
+        for i in range(60)
+    ]
+    domain, trials = _warm_trials(space, docs)
+    if quick:
+        n, rounds = 6, 2
+
+    def p50(enabled, ids_start, seed0):
+        sdiag.set_enabled(enabled)
+        try:
+            times = []
+            for i in range(n):
+                t0 = time.perf_counter()
+                tpe.suggest(
+                    [ids_start + i], domain, trials, seed0 + i,
+                    n_startup_jobs=10, n_EI_candidates=128, verbose=False,
+                )
+                times.append(time.perf_counter() - t0)
+        finally:
+            sdiag.set_enabled(True)
+        return float(np.median(times))
+
+    # warm the program once outside the timed sample
+    tpe.suggest([90_000], domain, trials, 0, n_startup_jobs=10,
+                n_EI_candidates=128, verbose=False)
+    regressions = []
+    ids = 100_000
+    for r in range(rounds):
+        base = p50(False, ids, 10 + r * 2 * n)
+        ids += n
+        on = p50(True, ids, 10 + r * 2 * n + n)
+        ids += n
+        regressions.append((on - base) / base)
+    return {
+        "n_per_round": n,
+        "rounds": rounds,
+        "p50_regression_frac": round(float(np.median(regressions)), 4),
+        "p50_regression_rounds": [round(r, 4) for r in regressions],
+    }
+
+
+# ---------------------------------------------------------------------
+# report
+# ---------------------------------------------------------------------
+
+
+def run_report(quick=False, overhead=True):
+    import jax
+    import numpy as np
+
+    from hyperopt_tpu.diagnostics import SearchStats
+    from hyperopt_tpu.models import domains as zoo
+
+    platform = jax.devices()[0].platform
+    t0 = time.time()
+
+    # --- healthy domains: all must verdict OK -------------------------
+    domains = HEALTHY_DOMAINS[:2] if quick else HEALTHY_DOMAINS
+    max_evals = 30 if quick else 60
+    healthy = {}
+    for name in domains:
+        d = zoo.get(name)
+        optimum = (
+            float(d.fmin)
+            if d.fmin is not None and np.isfinite(d.fmin) else None
+        )
+        stats = SearchStats(
+            n_startup_jobs=20, stall_window=max_evals, optimum=optimum,
+        )
+        _fmin_stats(
+            d.fn, d.space, seed=0, max_evals=max_evals, stats=stats,
+            n_EI_candidates=64,
+        )
+        h = stats.health()
+        snap = stats.snapshot()
+        labels = (snap["last_suggest"] or {}).get("labels", {})
+        flats = [
+            v["ei_flatness"] for v in labels.values()
+            if v["ei_flatness"] is not None
+        ]
+        healthy[name] = {
+            "state": h["state"],
+            "rules": [r["rule"] for r in h["rules"]],
+            "best_loss": snap["best_loss"],
+            "regret": snap["regret"],
+            "n_results": snap["n_results"],
+            "ei_flatness_mean": (
+                round(float(np.mean(flats)), 4) if flats else None
+            ),
+            "ok": h["state"] == "OK",
+        }
+
+    # --- degenerate fixtures: each flagged with its intended rule -----
+    fixtures = {}
+    for name, intended_rule, fn in FIXTURES:
+        stats, extra = fn(quick)
+        h = stats.health()
+        fired = {r["rule"] for r in h["rules"]}
+        rec = {
+            "intended_rule": intended_rule,
+            "state": h["state"],
+            "rule": h["rule"],
+            "rules": [r["rule"] for r in h["rules"]],
+            "detail": h["rules"][0]["detail"] if h["rules"] else None,
+            # the intended rule must OWN the verdict, not merely fire
+            "ok": h["rule"] == intended_rule and intended_rule in fired,
+        }
+        rec.update(extra)
+        if name == "warmup_boundary":
+            # the boundary is two-sided: one short of n_startup_jobs is
+            # WARMUP, past it is not
+            rec["ok"] = rec["ok"] and rec["past_boundary_state"] != "WARMUP"
+        fixtures[name] = rec
+
+    # --- zero-dispatch + overhead -------------------------------------
+    zd = zero_dispatch_check(quick)
+    overhead_rec = measure_overhead(quick) if overhead else None
+
+    ok = (
+        all(v["ok"] for v in healthy.values())
+        and all(v["ok"] for v in fixtures.values())
+        and zd["ok"]
+        and (
+            overhead_rec is None
+            or overhead_rec["p50_regression_frac"] < 0.05
+        )
+    )
+    return {
+        "metric": "study_health",
+        "platform": platform,
+        "quick": bool(quick),
+        "max_evals_healthy": max_evals,
+        "healthy": healthy,
+        "fixtures": fixtures,
+        "zero_dispatch": zd,
+        "overhead": overhead_rec,
+        "elapsed_s": round(time.time() - t0, 2),
+        "ok": ok,
+    }
+
+
+def write_report(report, path):
+    with open(path, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=False)
+        f.write("\n")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--out", default="STUDY_HEALTH.json")
+    parser.add_argument("--no-overhead", action="store_true")
+    options = parser.parse_args(argv)
+    report = run_report(
+        quick=options.quick, overhead=not options.no_overhead
+    )
+    write_report(report, options.out)
+    print(json.dumps({
+        "metric": report["metric"],
+        "ok": report["ok"],
+        "healthy": {k: v["state"] for k, v in report["healthy"].items()},
+        "fixtures": {
+            k: f"{v['state']} (want {v['intended_rule']})"
+            for k, v in report["fixtures"].items()
+        },
+        "extra_dispatches": report["zero_dispatch"]["extra_dispatches"],
+        "overhead": (
+            report["overhead"]["p50_regression_frac"]
+            if report["overhead"] else None
+        ),
+        "out": options.out,
+    }))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

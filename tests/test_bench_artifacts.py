@@ -187,6 +187,52 @@ def test_host_traffic_flat_from_10k_to_100k_history():
 DEVICE_PROFILE = os.path.join(ROOT, "DEVICE_PROFILE.json")
 
 
+STUDY_HEALTH = os.path.join(ROOT, "STUDY_HEALTH.json")
+
+
+@pytest.mark.skipif(
+    not os.path.exists(STUDY_HEALTH),
+    reason="no committed study-health artifact",
+)
+def test_study_health_artifact_flags_every_fixture():
+    """The ISSUE-8 acceptance artifact: every seeded degenerate fixture
+    is flagged with its intended SH5xx rule, all healthy QUALITY.md
+    domains report OK, the EI statistics provably add zero device
+    dispatches (dispatch-count + one-trace-per-bucket assertions), and
+    the measured suggest overhead is <5%."""
+    d = _load(STUDY_HEALTH)
+    assert d["metric"] == "study_health"
+    assert d["ok"] is True
+    # the committed artifact is the FULL capture (quick runs write
+    # STUDY_HEALTH.quick.json and must never clobber this one)
+    assert d["quick"] is False
+    # healthy domains: the full QUALITY.md set, all OK
+    assert set(d["healthy"]) == {
+        "quadratic1", "branin", "gauss_wave2", "hartmann6"
+    }
+    for name, rec in d["healthy"].items():
+        assert rec["state"] == "OK", (name, rec)
+        assert rec["ok"] is True
+    # one fixture per degenerate rule, each owned by its intended id
+    intended = {v["intended_rule"] for v in d["fixtures"].values()}
+    assert intended == {
+        "SH501", "SH502", "SH503", "SH504", "SH505", "SH506"
+    }
+    for name, rec in d["fixtures"].items():
+        assert rec["ok"] is True, (name, rec)
+        assert rec["rule"] == rec["intended_rule"], (name, rec)
+    # zero-dispatch contract: EI stats ride the existing fused readback
+    zd = d["zero_dispatch"]
+    assert zd["ok"] is True
+    assert zd["extra_dispatches"] == 0
+    assert zd["n_dispatches"] == zd["n_suggests"]
+    assert zd["n_diag_snapshots"] == zd["n_suggests"]
+    assert zd["retrace_violations"] == []
+    # measured host-side overhead: suggest p50 within 5%
+    assert d["overhead"] is not None
+    assert d["overhead"]["p50_regression_frac"] < 0.05
+
+
 @pytest.mark.skipif(
     not os.path.exists(DEVICE_PROFILE),
     reason="no committed device-profile artifact",

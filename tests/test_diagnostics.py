@@ -1,0 +1,647 @@
+"""Search-health observability (ISSUE 8): fused-readback EI/Parzen
+introspection, the SearchStats accumulator, the SH5xx health classifier,
+its service surfaces (/v1/study_status health block, bounded per-study
+/metrics gauges), and the no_progress_stop early-stop hook.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, hp
+from hyperopt_tpu import diagnostics as sdiag
+from hyperopt_tpu.base import JOB_STATE_DONE, STATUS_OK, Domain
+from hyperopt_tpu.diagnostics import DIAG_COLS, SearchStats
+
+_SCRIPTS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+)
+
+
+def _study_report():
+    sys.path.insert(0, _SCRIPTS)
+    try:
+        import study_report
+    finally:
+        try:
+            sys.path.remove(_SCRIPTS)
+        except ValueError:
+            pass
+    return study_report
+
+
+def _done_doc(tid, vals, loss):
+    return {
+        "tid": tid, "spec": None,
+        "result": {"status": STATUS_OK, "loss": loss},
+        "misc": {
+            "tid": tid, "cmd": None,
+            "idxs": {k: [tid] for k in vals},
+            "vals": {k: [v] for k, v in vals.items()},
+        },
+        "state": JOB_STATE_DONE, "owner": None, "book_time": None,
+        "refresh_time": None, "exp_key": None,
+    }
+
+
+def _warm_trials(space, docs):
+    domain = Domain(lambda c: 0.0, space)
+    trials = Trials()
+    trials._insert_trial_docs(docs)
+    trials.refresh()
+    return domain, trials
+
+
+def _mixed_setup(n=12, seed=0):
+    rng = np.random.default_rng(seed)
+    space = {
+        "x": hp.uniform("x", -5, 5),
+        "lr": hp.loguniform("lr", -5, 0),
+        "c": hp.choice("c", ["a", "b", "d"]),
+    }
+    docs = [
+        _done_doc(i, {
+            "x": float(rng.uniform(-5, 5)),
+            "lr": float(np.exp(rng.uniform(-5, 0))),
+            "c": int(rng.integers(3)),
+        }, float(rng.normal()))
+        for i in range(n)
+    ]
+    return _warm_trials(space, docs)
+
+
+# ---------------------------------------------------------------------
+# fused-readback introspection
+# ---------------------------------------------------------------------
+
+
+class TestFusedDiag:
+    def test_suggest_publishes_snapshot(self):
+        from hyperopt_tpu.algos import tpe
+
+        domain, trials = _mixed_setup(n=12)
+        sdiag.last_suggest_diag()  # clear any leftover
+        tpe.suggest(
+            [100], domain, trials, 7, n_startup_jobs=4,
+            n_EI_candidates=64, verbose=False,
+        )
+        snap = sdiag.last_suggest_diag()
+        assert snap is not None
+        assert snap["n_below"] >= 1 and snap["n_eff"] == 12
+        assert set(snap["labels"]) == {"x", "lr", "c"}
+        for lb in ("x", "lr"):
+            d = snap["labels"][lb]
+            assert d["kind"] == "cont"
+            assert d["nb"] + d["na"] <= 12
+            assert d["nb"] >= 1
+            assert d["ei_flatness"] is not None and d["ei_flatness"] >= 0
+            assert 0.0 < d["ei_top_mass"] <= 1.0 + 1e-6
+            assert d["sigma_min_rel"] is not None
+            assert 0.0 <= d["sigma_floor_frac"] <= 1.0
+        c = snap["labels"]["c"]
+        assert c["kind"] == "idx"
+        assert c["support"] == 3
+        assert 1 <= c["n_distinct"] <= 3
+        assert 0.0 <= c["dup_frac"] <= 1.0
+
+    def test_snapshot_consumed_once(self):
+        from hyperopt_tpu.algos import tpe
+
+        domain, trials = _mixed_setup(n=12)
+        tpe.suggest(
+            [101], domain, trials, 8, n_startup_jobs=4,
+            n_EI_candidates=32, verbose=False,
+        )
+        assert sdiag.last_suggest_diag() is not None
+        assert sdiag.last_suggest_diag() is None  # consumed
+
+    def test_disabled_publishes_nothing(self):
+        from hyperopt_tpu.algos import tpe
+
+        domain, trials = _mixed_setup(n=12)
+        sdiag.last_suggest_diag()
+        sdiag.set_enabled(False)
+        try:
+            tpe.suggest(
+                [102], domain, trials, 9, n_startup_jobs=4,
+                n_EI_candidates=32, verbose=False,
+            )
+            assert sdiag.last_suggest_diag() is None
+        finally:
+            sdiag.set_enabled(True)
+
+    def test_resolver_diag_shape(self):
+        """The async resolver exposes one [L, DIAG_COLS] row block per
+        family request, aligned with the winner arrays."""
+        from hyperopt_tpu.algos import tpe, tpe_device
+
+        domain, trials = _mixed_setup(n=12)
+        prep = tpe.suggest_prepare(
+            [103], domain, trials, 11, n_startup_jobs=4,
+            n_EI_candidates=32,
+        )
+        assert prep is not None
+        resolve = tpe_device.multi_family_suggest_async(prep[0])
+        outs = resolve()
+        diags = resolve.diag
+        assert len(diags) == len(outs)
+        for win, diag in zip(outs, diags):
+            assert diag.shape == (win.shape[0], DIAG_COLS)
+
+    def test_zero_extra_dispatches_and_one_trace_budget(self):
+        """THE zero-dispatch contract: the EI statistics ride the
+        existing fused readback — M suggests produce exactly M profiled
+        dispatches and stay inside the RecompilationAuditor's
+        one-trace-per-(bucket, family) budget."""
+        from hyperopt_tpu import profiling
+        from hyperopt_tpu.algos import tpe
+        from hyperopt_tpu.analysis import RecompilationAuditor
+        from hyperopt_tpu.observability import DeviceStats
+
+        domain, trials = _mixed_setup(n=12, seed=3)
+        stats = DeviceStats()
+        n = 6
+        with profiling.DeviceProfiler(stats=stats):
+            with RecompilationAuditor() as aud:
+                for i in range(n):
+                    tpe.suggest(
+                        [200 + i], domain, trials, i, n_startup_jobs=4,
+                        n_EI_candidates=64, verbose=False,
+                    )
+                    assert sdiag.last_suggest_diag() is not None
+        assert stats.n_dispatches == n
+        assert all(c == 1 for c in aud.trace_counts.values()), (
+            aud.trace_counts
+        )
+
+    def test_batched_multi_study_diag_per_group(self):
+        from hyperopt_tpu.algos import tpe, tpe_device
+
+        da, ta = _mixed_setup(n=12, seed=0)
+        db, tb = _mixed_setup(n=9, seed=1)
+        kw = dict(n_startup_jobs=4, n_EI_candidates=32)
+        prep_a = tpe.suggest_prepare([12], da, ta, 77, **kw)
+        prep_b = tpe.suggest_prepare([9, 10], db, tb, 88, **kw)
+        res_a, res_b = tpe_device.multi_study_suggest_async(
+            [prep_a[0], prep_b[0]]
+        )
+        outs_b = res_b()
+        outs_a = res_a()
+        assert len(res_a.diag) == len(outs_a)
+        assert len(res_b.diag) == len(outs_b)
+        for win, diag in zip(outs_a, res_a.diag):
+            assert diag.shape == (win.shape[0], DIAG_COLS)
+
+
+# ---------------------------------------------------------------------
+# SearchStats + classifier units (synthetic snapshots)
+# ---------------------------------------------------------------------
+
+
+def _cont_label(nb=10, na=30, flat=1.0, floor_frac=0.0):
+    return {
+        "kind": "cont", "nb": nb, "na": na, "ei_max": flat,
+        "ei_flatness": flat, "ei_top_mass": 0.5,
+        "sigma_min_rel": 0.2, "sigma_mean_rel": 0.5,
+        "sigma_floor_frac": floor_frac,
+    }
+
+
+def _idx_label(nb=5, na=20, flat=1.0, n_distinct=2, support=3,
+               dup_frac=0.0):
+    return {
+        "kind": "idx", "nb": nb, "na": na, "ei_max": flat,
+        "ei_flatness": flat, "ei_top_mass": 0.5,
+        "n_distinct": n_distinct, "dup_frac": dup_frac,
+        "support": support,
+    }
+
+
+def _snap(labels):
+    return {
+        "n_below": 5, "gamma": 0.25, "n_eff": 40, "k": 1, "n_cand": 64,
+        "labels": labels,
+    }
+
+
+def _fed(stats, n_ok=40, loss_fn=None):
+    for i in range(n_ok):
+        loss = loss_fn(i) if loss_fn else 100.0 - i
+        stats.record_result(loss=loss, status="ok")
+    return stats
+
+
+class TestClassifier:
+    def test_ok(self):
+        s = _fed(SearchStats(n_startup_jobs=20, stall_window=50))
+        s.record_suggest(_snap({"x": _cont_label()}))
+        h = s.health()
+        assert h["state"] == "OK" and h["rule"] == "SH500"
+        assert h["rules"] == []
+
+    def test_warmup_boundary(self):
+        s = SearchStats(n_startup_jobs=20)
+        _fed(s, n_ok=19)
+        assert s.health()["rule"] == "SH501"
+        s.record_result(loss=0.0, status="ok")
+        assert s.health()["rule"] != "SH501"
+
+    def test_stalled_and_improving(self):
+        s = _fed(
+            SearchStats(n_startup_jobs=10, stall_window=15),
+            n_ok=40, loss_fn=lambda i: 5.0 if i > 10 else 100.0 - i,
+        )
+        h = s.health()
+        assert h["rule"] == "SH502" and h["state"] == "STALLED"
+        improving = _fed(
+            SearchStats(n_startup_jobs=10, stall_window=15), n_ok=40
+        )
+        assert improving.health()["state"] == "OK"
+
+    def test_flat_ei(self):
+        s = _fed(SearchStats(n_startup_jobs=20, stall_window=100))
+        s.record_suggest(_snap({"x": _cont_label(flat=0.01)}))
+        h = s.health()
+        assert h["rule"] == "SH503" and h["state"] == "FLAT_EI"
+
+    def test_sigma_collapse(self):
+        s = _fed(SearchStats(n_startup_jobs=20, stall_window=100))
+        s.record_suggest(_snap({"x": _cont_label(floor_frac=0.9)}))
+        h = s.health()
+        assert h["rule"] == "SH504" and h["state"] == "SIGMA_COLLAPSE"
+
+    def test_sigma_collapse_needs_enough_obs(self):
+        s = _fed(SearchStats(n_startup_jobs=20, stall_window=100))
+        s.record_suggest(
+            _snap({"x": _cont_label(nb=3, floor_frac=1.0)})
+        )
+        assert s.health()["rule"] != "SH504"
+
+    def test_space_exhausted_all_discrete_only(self):
+        s = _fed(SearchStats(n_startup_jobs=20, stall_window=100))
+        s.record_suggest(_snap({
+            "c": _idx_label(n_distinct=3, support=3, dup_frac=1.0),
+        }))
+        assert s.health()["rule"] == "SH505"
+        # a continuous dimension means the space is not enumerable
+        s2 = _fed(SearchStats(n_startup_jobs=20, stall_window=100))
+        s2.record_suggest(_snap({
+            "c": _idx_label(n_distinct=3, support=3, dup_frac=1.0),
+            "x": _cont_label(),
+        }))
+        assert s2.health()["rule"] != "SH505"
+
+    def test_fault_degraded(self):
+        s = SearchStats(n_startup_jobs=5, fault_min_results=8)
+        for i in range(4):
+            s.record_result(loss=float(i), status="ok")
+        for _ in range(12):
+            s.record_result(loss=float("nan"), status="ok")
+        h = s.health()
+        assert h["rule"] == "SH506" and h["state"] == "FAULT_DEGRADED"
+
+    def test_priority_and_all_rules_reported(self):
+        """A study can be simultaneously flat and stalled; priority
+        gives FLAT_EI the state, but SH502 stays in the rule list (the
+        early-stop hook depends on this)."""
+        s = _fed(
+            SearchStats(n_startup_jobs=10, stall_window=15),
+            n_ok=40, loss_fn=lambda i: 5.0,
+        )
+        s.record_suggest(_snap({"x": _cont_label(flat=0.01)}))
+        h = s.health()
+        assert h["rule"] == "SH503"
+        assert {r["rule"] for r in h["rules"]} >= {"SH502", "SH503"}
+
+    def test_quarantine_counts_via_fault_stats(self):
+        from hyperopt_tpu.observability import FaultStats
+
+        fs = FaultStats()
+        fs.record("trial_quarantined", 10)
+        s = SearchStats(
+            n_startup_jobs=5, fault_stats=fs, fault_min_results=8
+        )
+        for i in range(10):
+            s.record_result(loss=float(i), status="ok")
+        snap = s.snapshot()
+        assert snap["faults"]["n_quarantined"] == 10
+        assert s.health()["rule"] == "SH506"
+
+    def test_observe_trials_counts_nan_and_errors(self):
+        from hyperopt_tpu.base import JOB_STATE_ERROR
+
+        domain, trials = _mixed_setup(n=6)
+        bad = _done_doc(100, {"x": 0.0, "lr": 0.1, "c": 1}, float("nan"))
+        err = _done_doc(101, {"x": 0.0, "lr": 0.1, "c": 1}, 0.0)
+        err["state"] = JOB_STATE_ERROR
+        trials._insert_trial_docs([bad, err])
+        trials.refresh()
+        s = SearchStats(n_startup_jobs=2)
+        s.observe_trials(trials)
+        snap = s.snapshot()
+        assert snap["faults"]["n_nan"] == 1
+        assert snap["faults"]["n_error"] == 1
+        assert snap["n_ok"] == 6
+        # idempotent re-observe
+        s.observe_trials(trials)
+        assert s.snapshot()["n_results"] == snap["n_results"]
+
+    def test_regret_curve_and_optimum(self):
+        s = SearchStats(n_startup_jobs=1, optimum=1.0)
+        for loss in (5.0, 3.0, 4.0, 2.0):
+            s.record_result(loss=loss, status="ok")
+        snap = s.snapshot()
+        assert snap["best_loss"] == 2.0
+        assert snap["regret"] == pytest.approx(1.0)
+        bests = [p["best"] for p in snap["regret_curve"]]
+        assert bests == [5.0, 3.0, 2.0]  # improvements only
+        assert bests == sorted(bests, reverse=True)
+
+
+# ---------------------------------------------------------------------
+# golden seeded fixtures (shared with scripts/study_report.py)
+# ---------------------------------------------------------------------
+
+
+class TestSeededFixtures:
+    """One seeded fixture per SH5xx rule, single-sourced from the
+    report script so the committed STUDY_HEALTH.json and the test
+    suite can never disagree about what a fixture is."""
+
+    @pytest.mark.parametrize(
+        "name",
+        [name for name, _, _ in (
+            ("warmup_boundary", None, None),
+            ("flat_ei_indistinct_choice", None, None),
+            ("sigma_collapse_identical_best", None, None),
+            ("exhausted_3_choice", None, None),
+            ("nan_storm_objective", None, None),
+        )],
+    )
+    def test_fixture_golden_rule(self, name):
+        rep = _study_report()
+        intended, fn = next(
+            (rule, f) for n, rule, f in rep.FIXTURES if n == name
+        )
+        stats, extra = fn(quick=True)
+        h = stats.health()
+        assert h["rule"] == intended, (name, h)
+        if name == "warmup_boundary":
+            assert extra["past_boundary_state"] != "WARMUP"
+
+    @pytest.mark.slow
+    def test_stalled_fixture_golden_rule(self):
+        rep = _study_report()
+        _, rule, fn = next(
+            x for x in rep.FIXTURES if x[0] == "stalled_plateau"
+        )
+        stats, _ = fn(quick=True)
+        assert stats.health()["rule"] == rule
+
+
+# ---------------------------------------------------------------------
+# early stop
+# ---------------------------------------------------------------------
+
+
+class TestNoProgressStop:
+    def _run(self, obj, stop_fn, seed, max_evals):
+        from functools import partial
+
+        from hyperopt_tpu import fmin
+        from hyperopt_tpu.algos import tpe
+
+        trials = Trials()
+        fmin(
+            obj, {"x": hp.uniform("x", -5, 5)},
+            algo=partial(
+                tpe.suggest, n_startup_jobs=8, n_EI_candidates=32
+            ),
+            max_evals=max_evals, trials=trials,
+            rstate=np.random.default_rng(seed),
+            show_progressbar=False, verbose=False,
+            early_stop_fn=stop_fn,
+        )
+        return trials
+
+    def test_halts_plateaued_study(self):
+        from hyperopt_tpu.early_stop import no_progress_stop
+
+        stop = no_progress_stop(
+            iteration_stop_count=10, n_startup_jobs=8
+        )
+        trials = self._run(
+            lambda c: max(abs(c["x"]), 2.0), stop, seed=1, max_evals=60
+        )
+        # halted well short of the budget, and past warmup + window
+        assert 18 <= len(trials.trials) < 60
+        assert any(
+            r["rule"] == "SH502"
+            for r in stop.search_stats.health()["rules"]
+        )
+
+    def test_never_halts_improving_study(self):
+        from hyperopt_tpu.early_stop import no_progress_stop
+
+        cnt = {"n": 0}
+
+        def improving(c):
+            cnt["n"] += 1
+            return 100.0 - cnt["n"]
+
+        stop = no_progress_stop(
+            iteration_stop_count=10, n_startup_jobs=8
+        )
+        trials = self._run(improving, stop, seed=2, max_evals=40)
+        assert len(trials.trials) == 40
+
+
+# ---------------------------------------------------------------------
+# service surfaces
+# ---------------------------------------------------------------------
+
+
+SPACE = {"x": hp.uniform("x", -5, 5), "c": hp.choice("c", [0, 1, 2])}
+
+
+class TestServiceSurfaces:
+    def _drive(self, svc, study_id="s", n_trials=12, seed=0):
+        rng = np.random.default_rng(seed)
+        svc.create_study(study_id, SPACE, seed=seed, algo="tpe",
+                         algo_params={"n_startup_jobs": 4})
+        for _ in range(n_trials):
+            (t,) = svc.suggest(study_id, n=1)
+            svc.report(study_id, t["tid"], loss=float(rng.normal()))
+
+    def test_study_status_health_block(self):
+        from hyperopt_tpu.service.core import OptimizationService
+
+        svc = OptimizationService()
+        try:
+            self._drive(svc, n_trials=12)
+            st = svc.study_status("s")
+            assert st["seed_cursor"]["drawn"] == 12
+            assert st["seed_cursor"]["committed"] == 12
+            f = st["faults"]
+            assert f["n_error"] == 0 and f["n_nan"] == 0
+            assert f["fault_rate"] == 0.0
+            h = st["health"]
+            assert h["state"] in ("OK", "STALLED")
+            assert h["n_results"] == 12
+            assert h["best_loss"] is not None
+            # the fused snapshot made it through the batched scheduler
+            assert h["last_suggest"] is not None
+            assert set(h["last_suggest"]["labels"]) == {"x", "c"}
+        finally:
+            svc.close(timeout=5)
+
+    def test_nan_report_rejected_but_counted(self):
+        from hyperopt_tpu.service.core import OptimizationService
+
+        svc = OptimizationService()
+        try:
+            svc.create_study("n", SPACE, seed=0, algo="rand")
+            (t,) = svc.suggest("n", n=1)
+            with pytest.raises(ValueError):
+                svc.report("n", t["tid"], loss=float("nan"))
+            # an idempotent client retrying the rejected report must
+            # not double-count the one diverged trial
+            with pytest.raises(ValueError):
+                svc.report("n", t["tid"], loss=float("nan"))
+            st = svc.study_status("n")
+            assert st["faults"]["n_nan"] == 1
+        finally:
+            svc.close(timeout=5)
+
+    def test_error_reports_degrade_health(self):
+        from hyperopt_tpu.service.core import OptimizationService
+
+        svc = OptimizationService()
+        try:
+            svc.create_study("e", SPACE, seed=0, algo="rand",
+                             algo_params=None)
+            for _ in range(10):
+                (t,) = svc.suggest("e", n=1)
+                svc.report("e", t["tid"], status="fail")
+            st = svc.study_status("e")
+            assert st["faults"]["n_error"] == 10
+            # rand has no n_startup_jobs param; default warmup (20)
+            # still owns the state, but SH506 must be in the rule list
+            rules = {r["rule"] for r in st["health"]["rules"]}
+            assert "SH506" in rules
+        finally:
+            svc.close(timeout=5)
+
+    def test_metrics_gauges_and_cardinality_guard(self):
+        """Per-study gauge families are bounded at metrics_max_studies
+        (top-N by recency) and the truncation counter accounts for the
+        dropped studies — the million-study /metrics regression."""
+        from hyperopt_tpu.service.core import OptimizationService
+
+        svc = OptimizationService(metrics_max_studies=3)
+        try:
+            for i in range(5):
+                svc.create_study(f"s{i}", SPACE, seed=i, algo="rand")
+                (t,) = svc.suggest(f"s{i}", n=1)
+                svc.report(f"s{i}", t["tid"], loss=float(i))
+            text = svc.metrics_text()
+            lines = text.splitlines()
+            health_lines = [
+                ln for ln in lines
+                if ln.startswith("hyperopt_study_health{")
+            ]
+            assert len(health_lines) == 3
+            studies = {
+                ln.split('study="')[1].split('"')[0]
+                for ln in lines if 'study="' in ln
+                and ln.startswith("hyperopt_study_")
+            }
+            assert len(studies) == 3
+            # recency bound: the LAST-active studies survive
+            assert studies == {"s2", "s3", "s4"}
+            trunc = [
+                ln for ln in lines
+                if ln.startswith("hyperopt_studies_truncated_total")
+                and not ln.startswith("#")
+            ]
+            assert trunc and float(trunc[0].split()[-1]) >= 2.0
+            for gauge in ("hyperopt_study_best_loss{",
+                          "hyperopt_study_ei_flatness{",
+                          "hyperopt_study_gamma{",
+                          "hyperopt_study_n_below{",
+                          "hyperopt_study_ei_max{",
+                          "hyperopt_study_regret{"):
+                assert any(ln.startswith(gauge) for ln in lines), gauge
+        finally:
+            svc.close(timeout=5)
+
+    def test_health_attr_on_suggest_span(self):
+        from hyperopt_tpu import tracing
+        from hyperopt_tpu.service.core import OptimizationService
+
+        tracer = tracing.Tracer(sample=1.0)
+        svc = OptimizationService(tracer=tracer)
+        try:
+            svc.create_study("h", SPACE, seed=0, algo="tpe",
+                             algo_params={"n_startup_jobs": 2})
+            captured = []
+            orig_finish = tracer.finish
+
+            def capture(trace):
+                if trace is not None:
+                    captured.append(trace)
+                return orig_finish(trace)
+
+            tracer.finish = capture
+            svc.suggest("h", n=1)
+            roots = [
+                t.root for t in captured
+                if t.root is not None and t.root.name == "service.suggest"
+            ]
+            assert roots
+            attrs = roots[-1].attrs or {}
+            assert attrs.get("health") in sdiag.HEALTH_STATES
+            assert str(attrs.get("health_rule", "")).startswith("SH5")
+        finally:
+            svc.close(timeout=5)
+
+
+# ---------------------------------------------------------------------
+# prometheus shape + lint registration
+# ---------------------------------------------------------------------
+
+
+def test_render_prometheus_study_health_shape():
+    from hyperopt_tpu.observability import render_prometheus
+
+    text = render_prometheus(study_health={
+        "rows": [{
+            "study": "a", "best_loss": 1.5, "regret": 0.5,
+            "gamma": 0.25, "n_below": 4, "ei_max": 2.0,
+            "ei_flatness": 0.7, "state": "OK",
+        }],
+        "truncated_total": 7,
+    })
+    assert 'hyperopt_study_best_loss{study="a"} 1.5' in text
+    assert 'hyperopt_study_health{state="OK",study="a"} 1' in text
+    assert "hyperopt_studies_truncated_total 7" in text
+    # a study with no optimum declared renders NaN, not a crash
+    text2 = render_prometheus(study_health={
+        "rows": [{
+            "study": "b", "best_loss": None, "regret": None,
+            "gamma": None, "n_below": None, "ei_max": None,
+            "ei_flatness": None, "state": "WARMUP",
+        }],
+        "truncated_total": 0,
+    })
+    assert 'hyperopt_study_best_loss{study="b"} NaN' in text2
+
+
+def test_diagnostics_registered_in_race_lint():
+    from hyperopt_tpu.analysis import RACE_LINT_FILES, lint_races
+
+    paths = [p for p in RACE_LINT_FILES if p.endswith("diagnostics.py")]
+    assert paths, "diagnostics.py must be race-linted"
+    assert lint_races(paths=paths) == []
