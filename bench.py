@@ -746,6 +746,48 @@ def study_health_section(argv):
     return 0 if report["ok"] else 1
 
 
+def slo_section(argv):
+    """``python bench.py --slo [--quick]``: SLO-guardrail smoke — runs
+    the SL6xx acceptance report (scripts/slo_report.py) on CPU and
+    writes ``SLO_SERVE.json`` (SLO-gated healthy loadgen with the
+    warm/cold latency split and storage-plane reconciliation, one
+    seeded forced-breach fixture per rule each firing its intended id
+    with a parseable flight-recorder bundle, and the guardrails-on
+    overhead check <5%).  A quick run writes a separate file so CI can
+    never clobber the committed full artifact (the PR 7 convention).
+    Prints ONE JSON line like the other bench sections."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    slo_report = _import_script("slo_report")
+    quick = "--quick" in argv
+    out_path = "SLO_SERVE.quick.json" if quick else "SLO_SERVE.json"
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    t0 = time.time()
+    report = slo_report.run_report(quick=quick)
+    slo_report.write_report(report, out_path)
+    out = {
+        "metric": "slo_smoke",
+        "value": sum(1 for v in report["fixtures"].values() if v["ok"]),
+        "unit": "fixtures_breached",
+        "ok": report["ok"],
+        "healthy_ok": report["healthy"]["ok"],
+        "healthy_rules": {
+            r["rule"]: r["status"] for r in report["healthy"]["rules"]
+        },
+        "reconciliation_ok": (
+            report["healthy"]["reconciliation"]["ok"]
+        ),
+        "recorder_roundtrip_ok": report["recorder_roundtrip"]["ok"],
+        "overhead_p50_regression_frac": (
+            report["overhead"]["p50_regression_frac"]
+            if report["overhead"] else None
+        ),
+        "elapsed_s": round(time.time() - t0, 2),
+    }
+    print(json.dumps(out))
+    return 0 if report["ok"] else 1
+
+
 def device_profile_section(argv):
     """``python bench.py --device-profile [--quick]``: device-plane
     observability smoke — runs the roofline-profiled suggest workload
@@ -790,6 +832,9 @@ def device_profile_section(argv):
 
 
 def main():
+    if "--slo" in sys.argv[1:]:
+        argv = [a for a in sys.argv[1:] if a != "--slo"]
+        return slo_section(argv)
     if "--study-health" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--study-health"]
         return study_health_section(argv)

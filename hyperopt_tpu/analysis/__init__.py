@@ -87,6 +87,10 @@ RACE_LINT_FILES = (
     # shared Trace objects, and concurrent finishes serialize the log
     # append — span buffers and log-writer state carry guards
     os.path.join(_PKG_ROOT, "tracing.py"),
+    # SLO guardrails: the ticker thread, /metrics renders, and
+    # /v1/alerts reads evaluate concurrently; the flight recorder's
+    # rings are fed from handler threads while dumps snapshot them
+    os.path.join(_PKG_ROOT, "slo.py"),
     # device performance observability: resolver callbacks record
     # dispatches from scheduler/driver threads while /metrics renders —
     # the profiler's cost cache and the capture's trace state carry

@@ -262,6 +262,41 @@ SUGGEST_DURATION_BUCKETS = (
 )
 
 
+# Fixed histogram bucket upper bounds (seconds) for store fsync latency
+# — local SSDs fsync in fractions of a millisecond, NFS/GCS-fuse mounts
+# in tens to hundreds; the tail past 1 s is the "storage plane is the
+# bottleneck" evidence the segmented-store roadmap item needs.
+FSYNC_DURATION_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+)
+
+
+def quantile_from_counts(edges, counts, q):
+    """The q-quantile of a fixed-bucket histogram given per-bucket (NOT
+    cumulative) counts — shared by :class:`LatencyHistogram` and the SLO
+    engine's window deltas (a window histogram is the elementwise
+    difference of two cumulative snapshots).  ``counts`` has one more
+    entry than ``edges`` (the +Inf bucket); observations there report
+    the last finite edge (a floor).  None when empty."""
+    total = sum(counts)
+    if not total:
+        return None
+    rank = q * total
+    seen = 0.0
+    lo = 0.0
+    for i, edge in enumerate(edges):
+        n = counts[i]
+        if seen + n >= rank:
+            if n == 0:
+                return edge
+            frac = (rank - seen) / n
+            return lo + frac * (edge - lo)
+        seen += n
+        lo = edge
+    return edges[-1] if edges else None
+
+
 class LatencyHistogram:
     """A fixed-bucket latency histogram (the Prometheus histogram
     shape: cumulative ``_bucket{le=...}`` counts + ``_sum``/``_count``).
@@ -299,21 +334,18 @@ class LatencyHistogram:
         interpolated inside the containing bucket.  The +Inf bucket has
         no upper edge; observations there report the last finite edge
         (a floor — the true value is at least that)."""
-        if not self.total:
-            return None
-        rank = q * self.total
-        seen = 0.0
-        lo = 0.0
-        for i, edge in enumerate(self.buckets):
-            n = self.counts[i]
-            if seen + n >= rank:
-                if n == 0:
-                    return edge
-                frac = (rank - seen) / n
-                return lo + frac * (edge - lo)
-            seen += n
-            lo = edge
-        return self.buckets[-1] if self.buckets else None
+        return quantile_from_counts(self.buckets, self.counts, q)
+
+    def state(self) -> dict:
+        """A diffable snapshot: per-bucket (non-cumulative) counts plus
+        total/sum — what the SLO engine stores per tick so a window's
+        histogram is the elementwise difference of two snapshots."""
+        return {
+            "edges": self.buckets,
+            "counts": list(self.counts),
+            "total": self.total,
+            "sum_s": self.sum_s,
+        }
 
     def to_dict(self) -> dict:
         """Cumulative bucket counts keyed by upper edge (the Prometheus
@@ -355,10 +387,18 @@ class ServiceStats:
         self._lock = threading.Lock()
         self._requests = defaultdict(int)       # endpoint -> served
         self._rejected = defaultdict(int)       # endpoint -> 429s
+        self._errors = defaultdict(int)         # endpoint -> 5xx/504s
         self._replayed = defaultdict(int)       # endpoint -> journal hits
         self._study_suggests = defaultdict(int)  # study -> suggests served
         # the exported latency source of truth: fixed buckets, no window
         self._suggest_hist = LatencyHistogram()
+        # the warm/cold split: every suggest lands in the union histogram
+        # above AND in exactly one of these — "cold" means the fused
+        # dispatch that served it carried an XLA compile (first-touch),
+        # "warm" is steady state.  BENCH_SERVE's 26 s p99 next to a 39 ms
+        # p50 is the blended view; these attribute it.
+        self._suggest_hist_warm = LatencyHistogram()
+        self._suggest_hist_cold = LatencyHistogram()
         # ring buffer: a bounded human-readable sample of RECENT traffic
         # for /v1/status only (window size is reported alongside)
         self._suggest_latencies = deque(maxlen=int(max_latency_samples))
@@ -376,11 +416,13 @@ class ServiceStats:
         self._n_studies = 0
 
     def record_request(self, endpoint: str, seconds=None, study=None,
-                       replay=False):
+                       replay=False, cold=False):
         """``replay=True`` marks a response served from the idempotency
         journal: counted as a request, NEVER as a latency observation
         (journal hits are instant and would dilute the histogram's
-        tail exactly when retries spike)."""
+        tail exactly when retries spike).  ``cold=True`` marks a suggest
+        whose fused dispatch carried an XLA compile: it lands in the
+        union histogram AND the cold split (warm otherwise)."""
         with self._lock:
             self._requests[endpoint] += 1
             if endpoint == "suggest" and not replay:
@@ -388,11 +430,22 @@ class ServiceStats:
                     self._study_suggests[str(study)] += 1
                 if seconds is not None:
                     self._suggest_hist.observe(float(seconds))
+                    split = (
+                        self._suggest_hist_cold if cold
+                        else self._suggest_hist_warm
+                    )
+                    split.observe(float(seconds))
                     self._suggest_latencies.append(float(seconds))
 
     def record_rejection(self, endpoint: str):
         with self._lock:
             self._rejected[endpoint] += 1
+
+    def record_error(self, endpoint: str):
+        """A request that failed server-side (5xx/504) — the numerator
+        of the SL603 error-rate objective, next to backpressure 429s."""
+        with self._lock:
+            self._errors[endpoint] += 1
 
     def record_replay(self, endpoint: str):
         """A retried request answered from the idempotency journal —
@@ -457,6 +510,55 @@ class ServiceStats:
             "p99_ms": round(p99 * 1e3, 3) if p99 is not None else None,
         }
 
+    @staticmethod
+    def _split_quantiles(hist):
+        p50, p99 = hist.quantile(0.50), hist.quantile(0.99)
+        return {
+            "p50_ms": round(p50 * 1e3, 3) if p50 is not None else None,
+            "p99_ms": round(p99 * 1e3, 3) if p99 is not None else None,
+            "count": hist.total,
+        }
+
+    def split_latency_quantiles(self):
+        """{"warm": {...}, "cold": {...}} — the first-touch (compile-
+        carrying) vs steady-state attribution of the suggest latency."""
+        with self._lock:
+            return {
+                "warm": self._split_quantiles(self._suggest_hist_warm),
+                "cold": self._split_quantiles(self._suggest_hist_cold),
+            }
+
+    def warm_hist_state(self) -> dict:
+        """Diffable snapshot of the STEADY-STATE (compile-excluded)
+        suggest histogram — the SLO engine's latency-rule input (the
+        PR 7 convention: compile-carrying dispatches are real cost but
+        meaningless steady-state latency)."""
+        with self._lock:
+            return self._suggest_hist_warm.state()
+
+    def slo_counters(self) -> dict:
+        """The scalar counters the SLO engine snapshots per tick.
+        ``requests_mutating`` counts only the suggest/report/create
+        routes — the SL603 denominator must not be diluted by a
+        dashboard polling /v1/alerts or /metrics between incidents."""
+        with self._lock:
+            mutating = ("suggest", "report", "create_study")
+            return {
+                "requests_suggest": self._requests.get("suggest", 0),
+                "requests_mutating": sum(
+                    self._requests.get(e, 0) for e in mutating
+                ),
+                "requests_total": sum(self._requests.values()),
+                "rejected_total": sum(self._rejected.values()),
+                # numerator and denominator must cover the SAME routes:
+                # a flaky read-only endpoint's 500s would otherwise
+                # overstate the mutating error rate
+                "errors_mutating": sum(
+                    self._errors.get(e, 0) for e in mutating
+                ),
+                "errors_total": sum(self._errors.values()),
+            }
+
     def window_quantiles(self):
         """Ring-buffer quantiles over the last-N sample — the HUMAN
         numbers for /v1/status, with the window size spelled out so
@@ -503,6 +605,7 @@ class ServiceStats:
 
     def summary(self) -> dict:
         q = self.latency_quantiles()
+        split = self.split_latency_quantiles()
         window = self.window_quantiles()
         phases = self.phase_summary()
         compiles = self.compile_events()
@@ -515,6 +618,7 @@ class ServiceStats:
             return {
                 "requests": dict(sorted(self._requests.items())),
                 "rejected": dict(sorted(self._rejected.items())),
+                "errors": dict(sorted(self._errors.items())),
                 "idempotent_replays": dict(sorted(self._replayed.items())),
                 "study_suggests": dict(sorted(self._study_suggests.items())),
                 "n_dispatches": self._n_dispatches,
@@ -528,6 +632,9 @@ class ServiceStats:
                 "n_studies": self._n_studies,
                 # histogram-derived (all observations ever)
                 "suggest_latency": q,
+                # first-touch (compile-carrying) vs steady-state split
+                "suggest_latency_warm": split["warm"],
+                "suggest_latency_cold": split["cold"],
                 # ring-derived (recent window; human eyes only)
                 "suggest_latency_window": window,
                 "phase_seconds": phases,
@@ -579,9 +686,15 @@ class DeviceStats:
     """
 
     MAX_SIGNATURES = 128
+    MAX_RECENT = 128
 
     def __init__(self):
+        from collections import deque
+
         self._lock = threading.Lock()
+        # bounded ring of the most recent dispatch records — the flight
+        # recorder's device-plane evidence at breach time
+        self._recent = deque(maxlen=self.MAX_RECENT)  # guarded-by: _lock
         self._t_started = time.monotonic()
         self._n_dispatches = 0  # guarded-by: _lock
         self._n_requests = 0  # guarded-by: _lock
@@ -631,6 +744,7 @@ class DeviceStats:
             if live > self._live_bytes_hw:
                 self._live_bytes_hw = live
             self._last = dict(rec)
+            self._recent.append(dict(rec))
             sig = str(rec.get("sig", "?"))
             agg = self._sigs.get(sig)
             if agg is None:
@@ -677,6 +791,20 @@ class DeviceStats:
     def last_record(self):
         with self._lock:
             return dict(self._last) if self._last is not None else None
+
+    def recent_records(self) -> list:
+        """The last ``MAX_RECENT`` dispatch records, oldest first (a
+        snapshot) — pulled by the flight recorder at dump time."""
+        with self._lock:
+            return [dict(r) for r in self._recent]
+
+    def slo_counters(self) -> dict:
+        """The scalar counters the SLO engine snapshots per tick."""
+        with self._lock:
+            return {
+                "busy_s": self._busy_s,
+                "dispatches": self._n_dispatches,
+            }
 
     def duty_cycle(self):
         """Device-busy fraction of wall time since this object started
@@ -789,6 +917,225 @@ class DeviceStats:
         )
 
 
+class StoreStats:
+    """Storage-plane accounting for the FileTrials queue, the response
+    journal, and the lease protocol — the one telemetry plane that had
+    none (ISSUE 9), and the before/after evidence the segmented-store
+    roadmap item will be judged against.
+
+    Every durability-relevant filesystem operation lands here:
+
+    - **fsyncs** — count + fixed-bucket latency histogram + bytes, by
+      ``kind`` (``doc``/``journal``/``attachment``/``counter``/
+      ``lease``/``bundle``) — the SL606 objective's input;
+    - **doc writes** — trial-doc inserts/rewrites and their encoded
+      bytes (reconciles against trial counts: one insert + one result
+      write per completed trial on the service path);
+    - **directory scans** — every O(N) ``all_docs``/native state scan,
+      with entries scanned (the cost ``refresh_local`` exists to dodge);
+    - **refreshes** — local (in-memory recompute) vs full (disk
+      re-read); the local hit rate is the single-writer fast path
+      working as designed;
+    - **journal** — appends/bytes/compactions/torn lines of the
+      exactly-once response journal;
+    - **leases** — grants/renewals/reaps/clears;
+    - **quarantines** — torn docs moved aside by ``_read_doc``.
+
+    A bounded ring of recent notable ops (every fsync, with kind,
+    latency, and bytes) feeds the flight recorder at dump time.
+
+    Thread-safe: handler/scheduler/reaper/worker threads record while
+    ``/metrics`` renders concurrently.
+    """
+
+    MAX_RECENT_OPS = 256
+
+    # lock-order: _lock
+    def __init__(self):
+        from collections import deque
+
+        self._lock = threading.Lock()
+        self._fsync_hist = LatencyHistogram(FSYNC_DURATION_BUCKETS)  # guarded-by: _lock
+        self._fsync_kinds = defaultdict(int)  # guarded-by: _lock
+        self._fsync_bytes = 0  # guarded-by: _lock
+        self._doc_writes = 0  # guarded-by: _lock
+        self._doc_write_bytes = 0  # guarded-by: _lock
+        self._attachment_writes = 0  # guarded-by: _lock
+        self._attachment_bytes = 0  # guarded-by: _lock
+        self._scans = 0  # guarded-by: _lock
+        self._scan_entries = 0  # guarded-by: _lock
+        self._refresh_local = 0  # guarded-by: _lock
+        self._refresh_full = 0  # guarded-by: _lock
+        self._journal_appends = 0  # guarded-by: _lock
+        self._journal_bytes = 0  # guarded-by: _lock
+        self._journal_compactions = 0  # guarded-by: _lock
+        self._journal_torn = 0  # guarded-by: _lock
+        self._lease_events = defaultdict(int)  # guarded-by: _lock
+        self._quarantined = 0  # guarded-by: _lock
+        self._recent_ops = deque(maxlen=self.MAX_RECENT_OPS)  # guarded-by: _lock
+
+    # -- recording -----------------------------------------------------
+    def record_fsync(self, seconds: float, kind: str = "doc",
+                     nbytes: int = 0):
+        with self._lock:
+            self._fsync_hist.observe(float(seconds))
+            self._fsync_kinds[str(kind)] += 1
+            self._fsync_bytes += int(nbytes)
+            self._recent_ops.append({
+                "op": "fsync", "kind": str(kind),
+                "seconds": round(float(seconds), 6),
+                "bytes": int(nbytes), "t": time.time(),
+            })
+
+    def record_doc_write(self, nbytes: int):
+        with self._lock:
+            self._doc_writes += 1
+            self._doc_write_bytes += int(nbytes)
+
+    def record_attachment_write(self, nbytes: int):
+        with self._lock:
+            self._attachment_writes += 1
+            self._attachment_bytes += int(nbytes)
+
+    def record_scan(self, n_entries: int):
+        with self._lock:
+            self._scans += 1
+            self._scan_entries += int(n_entries)
+
+    def record_refresh(self, local: bool):
+        with self._lock:
+            if local:
+                self._refresh_local += 1
+            else:
+                self._refresh_full += 1
+
+    def record_journal_append(self, nbytes: int):
+        with self._lock:
+            self._journal_appends += 1
+            self._journal_bytes += int(nbytes)
+
+    def record_journal_compaction(self, nbytes: int = 0):
+        with self._lock:
+            self._journal_compactions += 1
+
+    def record_journal_torn(self, n: int = 1):
+        with self._lock:
+            self._journal_torn += int(n)
+
+    def record_lease(self, event: str, n: int = 1):
+        """``event``: grant | renew | reap | clear | quarantine."""
+        with self._lock:
+            self._lease_events[str(event)] += int(n)
+
+    def record_quarantine(self, n: int = 1):
+        with self._lock:
+            self._quarantined += int(n)
+
+    # -- reading -------------------------------------------------------
+    def fsync_hist_state(self) -> dict:
+        with self._lock:
+            return self._fsync_hist.state()
+
+    def fsync_histogram_dict(self) -> dict:
+        with self._lock:
+            return self._fsync_hist.to_dict()
+
+    def slo_counters(self) -> dict:
+        """The scalar counters the SLO engine snapshots per tick —
+        ``store_bad`` is the SL605 zero-tolerance numerator (torn
+        journal lines + quarantined docs)."""
+        with self._lock:
+            return {
+                "store_bad": self._journal_torn + self._quarantined,
+                "fsyncs_total": sum(self._fsync_kinds.values()),
+            }
+
+    def recent_ops(self) -> list:
+        """The last ``MAX_RECENT_OPS`` store operations, oldest first
+        (a snapshot) — pulled by the flight recorder at dump time."""
+        with self._lock:
+            return [dict(o) for o in self._recent_ops]
+
+    def summary(self) -> dict:
+        with self._lock:
+            p50 = self._fsync_hist.quantile(0.50)
+            p99 = self._fsync_hist.quantile(0.99)
+            n_refresh = self._refresh_local + self._refresh_full
+            return {
+                "fsyncs": dict(sorted(self._fsync_kinds.items())),
+                "fsyncs_total": sum(self._fsync_kinds.values()),
+                "fsync_bytes_total": self._fsync_bytes,
+                "fsync_p50_ms": (
+                    round(p50 * 1e3, 4) if p50 is not None else None
+                ),
+                "fsync_p99_ms": (
+                    round(p99 * 1e3, 4) if p99 is not None else None
+                ),
+                "fsync_sum_s": round(self._fsync_hist.sum_s, 6),
+                "doc_writes": self._doc_writes,
+                "doc_write_bytes": self._doc_write_bytes,
+                "attachment_writes": self._attachment_writes,
+                "attachment_bytes": self._attachment_bytes,
+                "scans": self._scans,
+                "scan_entries": self._scan_entries,
+                "refresh_local": self._refresh_local,
+                "refresh_full": self._refresh_full,
+                "refresh_local_hit_rate": (
+                    round(self._refresh_local / n_refresh, 4)
+                    if n_refresh else None
+                ),
+                "journal_appends": self._journal_appends,
+                "journal_bytes": self._journal_bytes,
+                "journal_compactions": self._journal_compactions,
+                "journal_torn_lines": self._journal_torn,
+                "lease_events": dict(sorted(self._lease_events.items())),
+                "quarantined_docs": self._quarantined,
+            }
+
+    def log_summary(self, level=logging.INFO):
+        s = self.summary()
+        if not s["fsyncs_total"] and not s["scans"]:
+            return
+        logger.log(
+            level,
+            "store: fsyncs=%d (p99 %sms) doc_writes=%d scans=%d "
+            "(entries=%d) refresh_local_rate=%s journal_appends=%d",
+            s["fsyncs_total"], s["fsync_p99_ms"], s["doc_writes"],
+            s["scans"], s["scan_entries"], s["refresh_local_hit_rate"],
+            s["journal_appends"],
+        )
+
+
+def build_info() -> dict:
+    """{"version", "jax", "backend"} — the identity labels of the
+    ``hyperopt_build_info`` gauge, so a scrape (or a flight-recorder
+    bundle) says WHAT it measured.  Never imports jax eagerly: an
+    uninitialized backend reports "uninitialized" rather than paying
+    (or worse, hanging on) device init inside a metrics render."""
+    import sys as _sys
+
+    try:
+        from . import __version__ as version
+    except ImportError:  # pragma: no cover - defensive
+        version = "unknown"
+    jax_mod = _sys.modules.get("jax")
+    jax_version = getattr(jax_mod, "__version__", None) or "not-imported"
+    backend = "uninitialized"
+    if jax_mod is not None:
+        try:
+            from jax._src import xla_bridge
+
+            if xla_bridge._backends:
+                backend = jax_mod.devices()[0].platform
+        except Exception:  # pragma: no cover - defensive
+            backend = "unknown"
+    return {
+        "version": str(version),
+        "jax": str(jax_version),
+        "backend": str(backend),
+    }
+
+
 # ---------------------------------------------------------------------
 # Prometheus text exposition
 # ---------------------------------------------------------------------
@@ -816,6 +1163,9 @@ def render_prometheus(
     service: "ServiceStats" = None,
     device: "DeviceStats" = None,
     study_health: dict = None,
+    store: "StoreStats" = None,
+    slo: list = None,
+    build: dict = None,
     extra: dict = None,
     namespace: str = "hyperopt",
 ):
@@ -835,6 +1185,12 @@ def render_prometheus(
     ``OptimizationService.metrics_text``), and ``truncated_total``
     counts the studies dropped by that bound so a million-study fleet
     can never blow up the exposition unnoticed.
+
+    ``store``: a :class:`StoreStats` — the storage-plane gauge block.
+    ``slo``: a list of SLO rule rows (``hyperopt_tpu.slo.SloEngine
+    .metrics_rows``) — status/burn-rate/breaches per SL6xx rule.
+    ``build``: the :func:`build_info` labels dict — one
+    ``hyperopt_build_info{version,jax,backend} 1`` identity gauge.
     """
     lines = []
 
@@ -884,6 +1240,16 @@ def render_prometheus(
              "Accumulated retry-backoff sleep.", "counter")
         sample("fault_backoff_seconds_total", None, faults.backoff_s)
 
+    def histogram(name, help_text, hist_dict):
+        head(name, help_text, "histogram")
+        for edge, cum in hist_dict["buckets"]:
+            le = "+Inf" if edge == float("inf") else repr(float(edge))
+            lines.append(f'{namespace}_{name}_bucket{{le="{le}"}} {cum}')
+        lines.append(
+            f"{namespace}_{name}_sum {_prom_value(hist_dict['sum_s'])}"
+        )
+        lines.append(f"{namespace}_{name}_count {hist_dict['count']}")
+
     if service is not None:
         s = service.summary()
         head("service_requests_total", "Requests served per endpoint.", "counter")
@@ -893,6 +1259,11 @@ def render_prometheus(
              "Requests rejected with backpressure per endpoint.", "counter")
         for endpoint, n in s["rejected"].items():
             sample("service_rejected_total", {"endpoint": endpoint}, n)
+        head("service_errors_total",
+             "Requests that failed server-side (5xx/504) per endpoint.",
+             "counter")
+        for endpoint, n in s.get("errors", {}).items():
+            sample("service_errors_total", {"endpoint": endpoint}, n)
         head("service_idempotent_replays_total",
              "Retried requests answered from the response journal.",
              "counter")
@@ -962,6 +1333,23 @@ def render_prometheus(
                 {"quantile": q_name},
                 s["suggest_latency"][q_key],
             )
+        head("service_suggest_split_latency_ms",
+             "Suggest latency quantiles split by first-touch attribution "
+             "(cold = the fused dispatch carried an XLA compile; warm = "
+             "steady state).", "gauge")
+        for split in ("warm", "cold"):
+            for q_key, q_name in (("p50_ms", "0.5"), ("p99_ms", "0.99")):
+                sample(
+                    "service_suggest_split_latency_ms",
+                    {"split": split, "quantile": q_name},
+                    s[f"suggest_latency_{split}"][q_key],
+                )
+        head("service_suggest_split_total",
+             "Suggests served per first-touch attribution class.",
+             "counter")
+        for split in ("warm", "cold"):
+            sample("service_suggest_split_total", {"split": split},
+                   s[f"suggest_latency_{split}"]["count"])
 
     if device is not None:
         s = device.summary()
@@ -1044,6 +1432,101 @@ def render_prometheus(
              "cardinality bound (top-N by recency).", "counter")
         sample("studies_truncated_total", None,
                study_health.get("truncated_total", 0))
+
+    if store is not None:
+        s = store.summary()
+        head("store_fsyncs_total",
+             "Storage-plane fsyncs by kind (doc/journal/attachment/"
+             "counter/lease/bundle).", "counter")
+        for kind, n in s["fsyncs"].items():
+            sample("store_fsyncs_total", {"kind": kind}, n)
+        histogram("store_fsync_duration_seconds",
+                  "fsync latency histogram across the storage plane "
+                  "(the SL606 objective's input).",
+                  store.fsync_histogram_dict())
+        head("store_fsync_bytes_total",
+             "Bytes written through fsync'd storage-plane writes.",
+             "counter")
+        sample("store_fsync_bytes_total", None, s["fsync_bytes_total"])
+        head("store_doc_writes_total",
+             "Trial-doc writes (inserts + state rewrites).", "counter")
+        sample("store_doc_writes_total", None, s["doc_writes"])
+        head("store_doc_write_bytes_total",
+             "Encoded bytes of trial-doc writes.", "counter")
+        sample("store_doc_write_bytes_total", None, s["doc_write_bytes"])
+        head("store_attachment_writes_total",
+             "Attachment blob writes (config, seed cursor, ...).",
+             "counter")
+        sample("store_attachment_writes_total", None,
+               s["attachment_writes"])
+        head("store_scans_total",
+             "O(N) trial-directory scans (all_docs / native state "
+             "scans) — the cost refresh_local exists to dodge.",
+             "counter")
+        sample("store_scans_total", None, s["scans"])
+        head("store_scan_entries_total",
+             "Directory entries touched by those scans.", "counter")
+        sample("store_scan_entries_total", None, s["scan_entries"])
+        head("store_refresh_total",
+             "Trials view refreshes: local (in-memory recompute) vs "
+             "full (disk re-read).", "counter")
+        sample("store_refresh_total", {"kind": "local"},
+               s["refresh_local"])
+        sample("store_refresh_total", {"kind": "full"}, s["refresh_full"])
+        head("store_journal_appends_total",
+             "Response-journal record appends (each one fsync'd).",
+             "counter")
+        sample("store_journal_appends_total", None, s["journal_appends"])
+        head("store_journal_bytes_total",
+             "Response-journal bytes appended.", "counter")
+        sample("store_journal_bytes_total", None, s["journal_bytes"])
+        head("store_journal_compactions_total",
+             "Response-journal in-place compactions.", "counter")
+        sample("store_journal_compactions_total", None,
+               s["journal_compactions"])
+        head("store_journal_torn_lines_total",
+             "Torn response-journal lines seen at load (SL605 input).",
+             "counter")
+        sample("store_journal_torn_lines_total", None,
+               s["journal_torn_lines"])
+        head("store_lease_events_total",
+             "Lease protocol events (grant/renew/reap/clear).", "counter")
+        for event, n in s["lease_events"].items():
+            sample("store_lease_events_total", {"event": event}, n)
+        head("store_quarantined_docs_total",
+             "Torn trial docs quarantined by the reader (SL605 input).",
+             "counter")
+        sample("store_quarantined_docs_total", None, s["quarantined_docs"])
+
+    if slo is not None:
+        head("slo_status",
+             "Per-rule SLO status (1 = breaching, 0 = within "
+             "objective; SL6xx catalog in docs/observability.md).",
+             "gauge")
+        for row in slo:
+            sample("slo_status", {"rule": row["rule"]},
+                   1 if row["status"] == "breach" else 0)
+        head("slo_burn_rate",
+             "Per-rule error-budget burn rate over the fast/slow "
+             "windows (>= 1 means the objective is being violated at "
+             "budget-exhausting speed).", "gauge")
+        for row in slo:
+            for window in ("fast", "slow"):
+                sample("slo_burn_rate",
+                       {"rule": row["rule"], "window": window},
+                       row.get(f"burn_{window}"))
+        head("slo_breaches_total",
+             "Breach transitions (ok -> breach) per rule since start.",
+             "counter")
+        for row in slo:
+            sample("slo_breaches_total", {"rule": row["rule"]},
+                   row.get("breaches_total", 0))
+
+    if build is not None:
+        head("build_info",
+             "Build/runtime identity (value is always 1; the labels "
+             "are the information).", "gauge")
+        sample("build_info", dict(build), 1)
 
     if extra:
         for key, value in sorted(extra.items()):

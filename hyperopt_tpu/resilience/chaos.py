@@ -172,7 +172,18 @@ class ChaosMonkey:
         self._roll_lock = threading.Lock()
         self._occurrence = defaultdict(int)  # guarded-by: _roll_lock
         self._installed_observer = None
+        # bounded ring of the most recent injections (log path or not)
+        # — the flight recorder's chaos-correlation evidence; deque
+        # appends are GIL-atomic, snapshots copy via list()
+        from collections import deque
+
+        self._recent = deque(maxlen=256)
         self._replay_injection_log()
+
+    def recent_injections(self) -> list:
+        """The last injections as record dicts, oldest first (a
+        snapshot) — pulled by the flight recorder at dump time."""
+        return [dict(r) for r in list(self._recent)]
 
     def _replay_injection_log(self):
         """Restore occurrence counters from the crash-surviving log.
@@ -235,15 +246,14 @@ class ChaosMonkey:
         ``CHAOS_SERVE.json`` campaign can be joined to the exact trace
         it perturbed — "this p99 outlier ate a torn-journal injection"
         becomes a log join instead of a guess."""
+        record = {
+            "site": site, "key": str(key), "occurrence": occ,
+            "trace_id": current_trace_id(),
+        }
+        self._recent.append(record)
         if not self.config.injection_log:
             return
-        line = json.dumps(
-            {
-                "site": site, "key": str(key), "occurrence": occ,
-                "trace_id": current_trace_id(),
-            },
-            sort_keys=True,
-        ) + "\n"
+        line = json.dumps(record, sort_keys=True) + "\n"
         try:
             fd = os.open(
                 self.config.injection_log,

@@ -156,6 +156,17 @@ class LeaseReaper:
         if self.stats is not None:
             self.stats.record(event)
 
+    @staticmethod
+    def _record_store_lease(event):
+        """Mirror a reap-protocol event into the process-wide storage
+        telemetry (observability.StoreStats) when one is installed —
+        the lease-churn axis of the SL6xx storage-plane evidence."""
+        from ..parallel.file_trials import store_stats
+
+        stats = store_stats()
+        if stats is not None:
+            stats.record_lease(event)
+
     # -- the protocol --------------------------------------------------
     def _lease_expired(self, tid, now) -> bool:
         lease = self.jobs.read_lease(tid)
@@ -195,6 +206,7 @@ class LeaseReaper:
                 f"{self.policy.max_attempts}; trial quarantined",
             )
             self._record("lease_quarantined")
+            self._record_store_lease("quarantine")
             with self._state_lock:
                 self._n_quarantined += 1
             logger.warning(
@@ -206,6 +218,7 @@ class LeaseReaper:
             doc["owner"] = None
             doc["book_time"] = None
             self._record("lease_reclaimed")
+            self._record_store_lease("reap")
             with self._state_lock:
                 self._n_reclaimed += 1
             logger.info(

@@ -101,6 +101,20 @@ def make_parser():
         help="how many fused dispatches --profile-dir captures",
     )
     p.add_argument(
+        "--flight-dir", default=None, dest="flight_dir",
+        help="flight-recorder bundle directory (default "
+             "<root>/flightrec when --root is set); bundles dump on "
+             "SLO breach, SIGQUIT, and unhandled crash",
+    )
+    p.add_argument(
+        "--no-slo", action="store_true", dest="no_slo",
+        help="turn the guardrails fully off: no SLO ticker, no "
+             "hyperopt_slo_* /metrics families, no storage-plane "
+             "instrumentation, no flight-recorder retention or dumps "
+             "(/v1/alerts still evaluates passively on the service "
+             "counters, with store/duty rules reading no_data)",
+    )
+    p.add_argument(
         "--chaos-config", default=None, dest="chaos_config",
         help="TESTING ONLY: JSON ChaosConfig activating seeded "
              "service-plane fault injection (torn writes, connection "
@@ -166,7 +180,18 @@ def main(argv=None):
         max_queue=options.max_queue,
         max_studies=options.max_studies,
         tracer=tracer,
+        slo_enabled=not options.no_slo,
+        flight_dir=options.flight_dir,
     )
+    # flight-recorder triggers beyond SLO breaches: SIGQUIT ("show me
+    # what you were doing") and unhandled crashes (the post-mortem
+    # always has its evidence).  --no-slo turns these off too: the
+    # guardrails-off server must not write bundles from any trigger.
+    from ..slo import install_crash_dump, install_signal_dump
+
+    if service.flight_recorder.bundle_dir and not options.no_slo:
+        install_signal_dump(service.flight_recorder)
+        install_crash_dump(service.flight_recorder)
     capture = None
     if options.profile_dir:
         from ..profiling import ProfileCapture

@@ -397,6 +397,11 @@ class ServiceClient:
     def service_status(self) -> dict:
         return self._request("GET", "/v1/status")
 
+    def alerts(self) -> dict:
+        """The SL6xx SLO rule table: per-rule status, multi-window burn
+        rates, breaching subset, and flight-recorder state."""
+        return self._request("GET", "/v1/alerts")
+
     def metrics(self) -> str:
         return self._request("GET", "/metrics")
 

@@ -245,6 +245,14 @@ def _journal_codec():
     return _json_default, _json_object_hook
 
 
+def _store_telemetry():
+    """The process-wide StoreStats (one definition, in
+    parallel.file_trials) — None when no service installed one."""
+    from ..parallel.file_trials import store_stats
+
+    return store_stats()
+
+
 class ResponseJournal:
     """Bounded, crash-consistent idempotency journal for one study.
 
@@ -322,6 +330,10 @@ class ResponseJournal:
         except FileNotFoundError:
             return
         entries, self.n_torn_lines = self.parse_lines(raw)
+        if self.n_torn_lines:
+            stats = _store_telemetry()
+            if stats is not None:
+                stats.record_journal_torn(self.n_torn_lines)
         entries.sort(key=lambda e: int(e.get("seq", 0)))
         with self._lock:
             for entry in entries[-self.max_entries:]:
@@ -336,6 +348,7 @@ class ResponseJournal:
         # the fsync here is THE durability point of the exactly-once
         # protocol — and a named phase in every trace that pays it
         with tracing.span("journal.fsync", n_bytes=len(line)):
+            t0 = time.perf_counter()
             fd = os.open(self.path, os.O_CREAT | os.O_WRONLY | os.O_APPEND,
                          0o644)
             try:
@@ -343,6 +356,12 @@ class ResponseJournal:
                 os.fsync(fd)
             finally:
                 os.close(fd)
+        stats = _store_telemetry()
+        if stats is not None:
+            stats.record_fsync(
+                time.perf_counter() - t0, kind="journal", nbytes=len(line)
+            )
+            stats.record_journal_append(len(line))
 
     # -- API -------------------------------------------------------------
     def get(self, key):
@@ -406,8 +425,11 @@ class ResponseJournal:
                         self._format_record(self._entries[k])
                         for k in self._order
                     )
-                    _atomic_write(self.path, blob)
+                    _atomic_write(self.path, blob, fsync_kind="journal")
                     self._appends_since_compact = 0
+                    stats = _store_telemetry()
+                    if stats is not None:
+                        stats.record_journal_compaction(len(blob))
         if self.path:
             chaos = _active_chaos()
             if chaos is not None:
@@ -980,7 +1002,7 @@ class _PendingSuggest:
         "study", "n", "ids", "seed", "draw_index", "docs", "payload",
         "error", "done", "done_event", "cancelled", "enqueued_at",
         "idempotency_key", "trace", "parent_span", "popped_at", "spanned",
-        "completed_at",
+        "completed_at", "compiled",
     )
 
     def __init__(self, study: Study, n: int, idempotency_key=None):
@@ -1006,6 +1028,10 @@ class _PendingSuggest:
         self.popped_at = None  # when the scheduler popped this request
         self.spanned = False   # intake spans recorded (once, not per retry)
         self.completed_at = None  # when complete()/fail() fired
+        # did the fused dispatch serving this request carry an XLA
+        # compile?  The whole batch waited on it, so the whole batch is
+        # "cold" — the first-touch vs steady-state latency attribution
+        self.compiled = False
 
     def complete(self, docs, payload=None):
         self.docs = docs
@@ -1290,6 +1316,7 @@ class SuggestScheduler:
         lead = next(
             (p for p, _, _ in finishes if p.trace is not None), None
         )
+        compiles_before = self.stats.n_compile_events
         t_launch0 = time.monotonic()
         with tracing.use_trace(
             lead.trace if lead is not None else None,
@@ -1313,6 +1340,16 @@ class SuggestScheduler:
         from .. import profiling
 
         roof = profiling.last_dispatch_record()
+        # first-touch attribution: the profiler's record tags dispatches
+        # that timed an XLA compile; without a profiler the compile-
+        # observer delta across this fused launch says the same thing.
+        # Every request in the batch waited on that compile — all cold.
+        batch_compiled = (
+            bool(roof["compiled"]) if roof is not None
+            else self.stats.n_compile_events > compiles_before
+        )
+        for p, _, _ in finishes:
+            p.compiled = batch_compiled
         roof_attrs = {}
         if roof is not None:
             roof_attrs = {
@@ -1434,8 +1471,24 @@ class OptimizationService:
                  max_studies=DEFAULT_MAX_STUDIES,
                  suggest_timeout=DEFAULT_SUGGEST_TIMEOUT,
                  fault_stats=None, startup_fsck=True, tracer=None,
-                 metrics_max_studies=DEFAULT_METRICS_MAX_STUDIES):
+                 metrics_max_studies=DEFAULT_METRICS_MAX_STUDIES,
+                 slo_enabled=True, slo_rules=None, flight_dir=None,
+                 slo_tick=None):
         self.stats = ServiceStats()
+        # storage-plane telemetry, installed process-wide BEFORE the
+        # startup fsck and registry recovery so their scans and journal
+        # loads are on the record too (latest-installed wins when
+        # several services share a process — tests).  slo_enabled=False
+        # is the full guardrails-off switch (no store instrumentation,
+        # no recorder retention, no ticker) — the overhead A/B's
+        # baseline arm.
+        from ..observability import StoreStats
+        from ..parallel import file_trials as _file_trials
+
+        self.slo_enabled = bool(slo_enabled)
+        self.store_stats = StoreStats()
+        if self.slo_enabled:
+            _file_trials.set_store_stats(self.store_stats)
         # per-study /metrics cardinality bound (top-N by recency) +
         # running count of studies the bound dropped from the exposition
         self.metrics_max_studies = int(metrics_max_studies)
@@ -1479,6 +1532,53 @@ class OptimizationService:
             self._recovery_ok = False
         # the gauge must reflect RECOVERED studies too, not just creates
         self.stats.set_n_studies(len(self.registry))
+        # SLO guardrails + flight recorder: the component that WATCHES
+        # the three telemetry pillars.  The recorder's rings are push
+        # (every finished trace) + pull (evidence providers read only
+        # at dump time); the engine's ticker thread takes the periodic
+        # burn-rate snapshots and fires dumps on breach transitions.
+        from .. import slo as slo_mod
+
+        bundle_dir = flight_dir
+        if bundle_dir is None and root:
+            bundle_dir = os.path.join(os.path.abspath(root), "flightrec")
+        self.flight_recorder = slo_mod.FlightRecorder(bundle_dir=bundle_dir)
+        self.flight_recorder.set_provider(
+            "dispatch", self.device_stats.recent_records
+        )
+        self.flight_recorder.set_provider(
+            "store_op", self.store_stats.recent_ops
+        )
+        self.flight_recorder.set_provider("chaos", self._recent_chaos)
+        self.flight_recorder.set_provider(
+            "study_health", self._recorder_health_rows
+        )
+        self.flight_recorder.set_provider(
+            "service", lambda: [{
+                "stats": self.stats.summary(),
+                "store": self.store_stats.summary(),
+                "tracing": self.tracer.summary(),
+            }]
+        )
+        if self.tracer is not tracing.DISABLED and self.slo_enabled:
+            # retain every finished trace regardless of head-sampling;
+            # a disabled tracer begins none, so off still means off
+            self.tracer.set_recorder(self.flight_recorder)
+        self.slo = slo_mod.SloEngine(
+            service_stats=self.stats,
+            device_stats=self.device_stats,
+            store_stats=self.store_stats,
+            rules=slo_rules,
+            # guardrails off means no breach-triggered dumps either —
+            # a /v1/alerts poll on a --no-slo server must stay passive
+            recorder=self.flight_recorder if self.slo_enabled else None,
+            fsck_unclean=not self._recovery_ok,
+        )
+        if self.slo_enabled:
+            self.slo.start(
+                slo_mod.DEFAULT_TICK_INTERVAL if slo_tick is None
+                else slo_tick
+            )
         self.scheduler = SuggestScheduler(
             stats=self.stats,
             device_recovery=self.device_recovery,
@@ -1649,6 +1749,11 @@ class OptimizationService:
         if n < 1:
             raise ValueError("n must be >= 1")
         t0 = time.perf_counter()
+        # first-touch attribution snapshot: a request is "cold" when an
+        # XLA compile ran anywhere in its lifetime — its own dispatch
+        # (pending.compiled) OR a compile it sat in queue behind.  Only
+        # requests untouched by compilation count as steady state.
+        compiles_before = self.stats.n_compile_events
         study = self.registry.get(study_id)
         with self._traced_request(
             "service.suggest", study=str(study_id), n=int(n)
@@ -1710,6 +1815,11 @@ class OptimizationService:
                 h = study.search_stats.health()
                 root.set_attr("health", h["state"])
                 root.set_attr("health_rule", h["rule"])
+                # ... and the fleet-level SLO state (a cheap cached
+                # read): a trace written during an incident says so
+                breaching = self.slo.current_breaching()
+                if breaching:
+                    root.set_attr("slo_breach", breaching)
             if (
                 trace is not None
                 and pending.trace is trace
@@ -1721,7 +1831,13 @@ class OptimizationService:
                     time.monotonic(), parent=root,
                 )
         dt = time.perf_counter() - t0
-        self.stats.record_request("suggest", seconds=dt, study=study_id)
+        self.stats.record_request(
+            "suggest", seconds=dt, study=study_id,
+            cold=(
+                pending.compiled
+                or self.stats.n_compile_events > compiles_before
+            ),
+        )
         self.timings.record("suggest", dt)
         return pending.payload
 
@@ -1762,17 +1878,48 @@ class OptimizationService:
         return self.registry.list()
 
     def service_status(self) -> dict:
+        from ..observability import build_info
+
         return {
             "studies": len(self.registry),
             "uptime_s": round(time.time() - self.started_at, 3),
+            "started_at": round(self.started_at, 3),
+            "version": build_info(),
             "draining": self._closed,
             "stats": self.stats.summary(),
             "faults": self.fault_stats.summary(),
             "device": self.device_stats.summary(),
+            "store": self.store_stats.summary(),
+            "slo_breaching": self.slo.current_breaching(),
             "recovery": dict(self.registry.recovery_info),
             "fsck": self.fsck_report,
             "tracing": self.tracer.summary(),
+            "flight_recorder": self.flight_recorder.summary(),
         }
+
+    def alerts(self) -> dict:
+        """The ``/v1/alerts`` document: the full SL6xx rule table with
+        multi-window burn rates, the breaching subset, and the flight
+        recorder's state."""
+        self.stats.record_request("alerts")
+        return self.slo.alerts_payload()
+
+    def _recent_chaos(self) -> list:
+        monkey = _active_chaos()
+        return monkey.recent_injections() if monkey is not None else []
+
+    def _recorder_health_rows(self) -> list:
+        """Bounded per-study health rows for the flight recorder (same
+        top-N-by-recency bound as /metrics, but without advancing the
+        truncation counter — a dump is not an exposition)."""
+        studies = self.registry.studies()
+        studies.sort(
+            key=lambda s: s.search_stats.last_activity, reverse=True
+        )
+        return [
+            s.search_stats.metrics_row()
+            for s in studies[: self.metrics_max_studies]
+        ]
 
     def readiness(self) -> dict:
         """The /readyz document: ready iff the registry recovered every
@@ -1814,7 +1961,7 @@ class OptimizationService:
         return [s.search_stats.metrics_row() for s in cut], total
 
     def metrics_text(self) -> str:
-        from ..observability import render_prometheus
+        from ..observability import build_info, render_prometheus
 
         rows, truncated = self._study_health_rows()
         return render_prometheus(
@@ -1823,6 +1970,9 @@ class OptimizationService:
             service=self.stats,
             device=self.device_stats,
             study_health={"rows": rows, "truncated_total": truncated},
+            store=self.store_stats,
+            slo=self.slo.metrics_rows() if self.slo_enabled else None,
+            build=build_info(),
             extra={"service_uptime_seconds": time.time() - self.started_at},
         )
 
@@ -1837,5 +1987,12 @@ class OptimizationService:
     def close(self, timeout=60.0):
         self._closed = True
         self.scheduler.close(timeout=timeout)
+        self.slo.close()
         self._uninstall_compile_observer()
         self.device_profiler.uninstall()
+        if self.tracer is not tracing.DISABLED:
+            self.tracer.set_recorder(None)
+        from ..parallel import file_trials as _file_trials
+
+        if _file_trials.store_stats() is self.store_stats:
+            _file_trials.set_store_stats(None)

@@ -15,6 +15,10 @@ API (all JSON unless noted)::
                                           probe (503 otherwise)
     GET  /metrics                         Prometheus text exposition
     GET  /v1/status                       service-wide stats snapshot
+    GET  /v1/alerts                       SL6xx SLO rule table (status,
+                                          multi-window burn rates,
+                                          breaching subset, flight-
+                                          recorder state)
     GET  /v1/studies                      {"studies": [id, ...]}
     GET  /v1/studies/<id>                 study status document
     POST /v1/studies                      create: {"study_id", "space_b64",
@@ -123,6 +127,18 @@ class _Handler(BaseHTTPRequestHandler):
             headers=headers,
         )
 
+    def _endpoint_label(self) -> str:
+        """Coarse endpoint label for the server-side error counter
+        (the SL603 numerator)."""
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if path.endswith("/suggest"):
+            return "suggest"
+        if path.endswith("/report"):
+            return "report"
+        if path == "/v1/studies" and self.command == "POST":
+            return "create_study"
+        return "other"
+
     def _dispatch(self, handler):
         try:
             handler()
@@ -135,6 +151,10 @@ class _Handler(BaseHTTPRequestHandler):
         except StudyExists as e:
             self._send_error_json(409, e)
         except TimeoutError as e:
+            # a timed-out suggest is a failed request the SLO layer
+            # must see (4xx client mistakes are not; 429s are counted
+            # as rejections at the submit site)
+            self.service.stats.record_error(self._endpoint_label())
             self._send_error_json(504, e)
         except (ValueError, KeyError, TypeError) as e:
             self._send_error_json(400, e)
@@ -142,6 +162,7 @@ class _Handler(BaseHTTPRequestHandler):
             pass
         except Exception as e:  # pragma: no cover - defensive
             logger.exception("unhandled service error")
+            self.service.stats.record_error(self._endpoint_label())
             self._send_error_json(500, e)
 
     @property
@@ -180,6 +201,8 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             elif path == "/v1/status":
                 self._send(200, self.service.service_status())
+            elif path == "/v1/alerts":
+                self._send(200, self.service.alerts())
             elif path == "/v1/studies":
                 self._send(200, {"studies": self.service.list_studies()})
             elif path.startswith("/v1/studies/"):

@@ -348,11 +348,13 @@ def head_sampled(trace_id: str, sample: float) -> bool:
     return h / 2 ** 32 < sample
 
 
-def format_record(payload: dict) -> bytes:
+def format_record(payload: dict, default=None) -> bytes:
     """One log record: ``\\n<crc32 hex> <json>`` in ONE buffer — the
     response journal's resync discipline (leading newline + per-record
-    CRC), so a torn append garbles at most itself."""
-    body = json.dumps(payload, sort_keys=True).encode()
+    CRC), so a torn append garbles at most itself.  ``default`` passes
+    through to ``json.dumps`` (the flight recorder stringifies
+    non-JSON evidence leaves; trace records never need it)."""
+    body = json.dumps(payload, sort_keys=True, default=default).encode()
     return b"\n%08x %s" % (zlib.crc32(body) & 0xFFFFFFFF, body)
 
 
@@ -417,6 +419,12 @@ class Tracer:
             None if slow_threshold_s is None else float(slow_threshold_s)
         )
         self.max_bytes = int(max_bytes)
+        # optional flight-recorder ring fed EVERY finished trace before
+        # the head-sampling keep/drop decision (None = not installed —
+        # the common case, one attribute read in finish()).  The
+        # sample-0 fast path is untouched: a disabled tracer begins no
+        # traces, so there is nothing to retain.
+        self._recorder = None
         self._io_lock = threading.Lock()
         self._bytes_written = 0  # guarded-by: _io_lock
         self._n_rotations = 0  # guarded-by: _io_lock
@@ -465,12 +473,24 @@ class Tracer:
             self._n_begun += 1
         return trace
 
+    def set_recorder(self, recorder):
+        """Install (or with None, remove) a flight recorder whose ring
+        retains every finished trace regardless of head-sampling."""
+        self._recorder = recorder
+
     def finish(self, trace):
         """Close out a trace: decide head-sample OR slow, then append
         its record.  Never raises — tracing must not fail a request."""
         if trace is None:
             return False
         try:
+            recorder = self._recorder
+            if recorder is not None:
+                # retention happens BEFORE the sampling decision: the
+                # recorder's window is "last N finished traces", and a
+                # head-dropped p99 outlier is exactly the evidence a
+                # breach bundle exists to carry
+                recorder.record_trace(trace)
             keep = trace.head_sampled
             if not keep and self.slow_threshold_s is not None:
                 root = trace.root

@@ -66,13 +66,22 @@ def _objective(point, rng):
 
 
 def run_loadgen(n_studies=8, n_trials=20, seed=0, batch_window=0.004,
-                root=None, tracer=None):
+                root=None, tracer=None, slo_gate=False, on_service=None,
+                service_kwargs=None):
     """Run the seeded campaign; returns the BENCH_SERVE.json payload.
 
     ``tracer``: an optional :class:`hyperopt_tpu.tracing.Tracer` — the
     server traces every sampled request end-to-end (clients send
     ``X-Hyperopt-Trace`` ids by default) and the caller aggregates the
-    trace log afterwards (``scripts/trace_report.py``)."""
+    trace log afterwards (``scripts/trace_report.py``).
+
+    ``slo_gate``: evaluate the SL6xx catalog after the campaign and
+    fold "no rule breaching" into the exit gate (the ROADMAP's
+    "SLO-gated loadgen"); the rule table lands in the report either
+    way.  ``on_service(service)`` runs before shutdown — the hook
+    slo_report uses to read stats the report does not carry.
+    ``service_kwargs`` pass through to OptimizationService (e.g.
+    ``slo_enabled=False`` for the overhead A/B)."""
     from hyperopt_tpu.fmin import space_eval
     from hyperopt_tpu.service import (
         OptimizationService,
@@ -81,8 +90,27 @@ def run_loadgen(n_studies=8, n_trials=20, seed=0, batch_window=0.004,
     )
 
     space = _space()
+    service_kwargs = dict(service_kwargs or {})
+    if slo_gate and "slo_rules" not in service_kwargs:
+        # SLO objectives are deployment config: the latency bounds are
+        # calibrated to the serving platform — a CPU-backend CI run
+        # legitimately pays ~seconds of fused-dispatch contention that
+        # a TPU serves in milliseconds, and its warm p50 shrinks as
+        # steady state accumulates while contention spikes keep the
+        # warm p99 at dispatch scale, stretching the ratio.  The CPU
+        # bounds (100x, 10 s) still catch the pathology on record —
+        # the ~670x blended ratio of the original BENCH_SERVE capture.
+        # The error/duty/store objectives are platform-independent.
+        from hyperopt_tpu import slo as slo_mod
+
+        tpu = _platform() == "tpu"
+        service_kwargs["slo_rules"] = slo_mod.default_rules(
+            latency_ratio={"ratio_max": 25.0 if tpu else 100.0},
+            latency_absolute={"p99_bound_s": 2.5 if tpu else 10.0},
+        )
     service = OptimizationService(
-        root=root, batch_window=batch_window, tracer=tracer
+        root=root, batch_window=batch_window, tracer=tracer,
+        **service_kwargs,
     )
     server = ServiceServer(service).start()
     errors = []
@@ -127,6 +155,15 @@ def run_loadgen(n_studies=8, n_trials=20, seed=0, batch_window=0.004,
             sid: service.study_status(sid)["n_completed"]
             for sid in service.list_studies()
         }
+        slo_rules = None
+        if slo_gate:
+            # one tick so the rule table reflects the whole campaign
+            # (tick evaluates and handles breach transitions); the gate
+            # reads that same cached evaluation
+            service.slo.tick()
+            slo_rules = service.slo.evaluate()
+        if on_service is not None:
+            on_service(service)
     finally:
         server.stop()
 
@@ -139,6 +176,8 @@ def run_loadgen(n_studies=8, n_trials=20, seed=0, batch_window=0.004,
         and occ > 1.5
         and stats["n_dispatches"] < stats["n_batched_suggests"]
     )
+    if slo_rules is not None:
+        ok = ok and all(r["status"] != "breach" for r in slo_rules)
     return {
         "metric": "serve_loadgen",
         "ok": ok,
@@ -153,6 +192,16 @@ def run_loadgen(n_studies=8, n_trials=20, seed=0, batch_window=0.004,
         "suggest_p99_ms": stats["suggest_latency"]["p99_ms"],
         "suggest_p50_exact_ms": exact["p50_ms"],
         "suggest_p99_exact_ms": exact["p99_ms"],
+        # the warm/cold split: first-touch (compile-carrying) vs
+        # steady-state, so the blended p99 above is ATTRIBUTED — a
+        # 26-second tail next to a 39 ms p50 is cold compiles, and
+        # these fields say so instead of leaving it to folklore
+        "suggest_warm_p50_ms": stats["suggest_latency_warm"]["p50_ms"],
+        "suggest_warm_p99_ms": stats["suggest_latency_warm"]["p99_ms"],
+        "suggest_cold_p50_ms": stats["suggest_latency_cold"]["p50_ms"],
+        "suggest_cold_p99_ms": stats["suggest_latency_cold"]["p99_ms"],
+        "n_warm_suggests": stats["suggest_latency_warm"]["count"],
+        "n_cold_suggests": stats["suggest_latency_cold"]["count"],
         "mean_batch_occupancy": occ,
         "n_dispatches": stats["n_dispatches"],
         "n_batched_suggests": stats["n_batched_suggests"],
@@ -163,6 +212,7 @@ def run_loadgen(n_studies=8, n_trials=20, seed=0, batch_window=0.004,
         "wall_s": round(wall_s, 3),
         "suggests_per_sec": round(total_suggests / wall_s, 2),
         "platform": _platform(),
+        **({"slo": slo_rules} if slo_rules is not None else {}),
     }
 
 
@@ -284,6 +334,12 @@ def main(argv=None):
         help="also run untraced and sample=0 campaigns and report the "
              "p50 regression (the tracing-off-is-free acceptance)",
     )
+    ap.add_argument(
+        "--slo-gate", action="store_true", dest="slo_gate",
+        help="evaluate the SL6xx SLO catalog after the campaign and "
+             "fail the exit gate if any rule is breaching (the rule "
+             "table lands in the report either way)",
+    )
     options = ap.parse_args(argv)
     n_trials = 8 if options.quick else options.trials
     if options.trace:
@@ -308,6 +364,7 @@ def main(argv=None):
         n_trials=n_trials,
         seed=options.seed,
         batch_window=options.batch_window,
+        slo_gate=options.slo_gate,
     )
     print(json.dumps(report, indent=1))
     if options.out:

@@ -1,0 +1,317 @@
+"""Prometheus exposition-format validator (ISSUE 9 satellite).
+
+Every stats family used to be shape-tested in isolation; this is the
+parser-level check over the FULL ``render_prometheus(...)`` output with
+every section populated at once: HELP/TYPE pairing for every exposed
+metric, valid sample lines, label-value escaping, no duplicate series,
+histogram structure (monotone cumulative buckets, ``+Inf`` == count),
+and counter naming.  A new stats family added without exposition
+discipline fails here, not in a scrape.
+"""
+
+import math
+import re
+
+from hyperopt_tpu.observability import (
+    DeviceStats,
+    FaultStats,
+    PhaseTimings,
+    ServiceStats,
+    SpeculationStats,
+    StoreStats,
+    build_info,
+    render_prometheus,
+)
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+# one sample line: name{labels} value  (labels optional)
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r" (?P<value>\S+)$"
+)
+# one label pair inside {...}: key="escaped value"
+_LABEL_PAIR_RE = re.compile(
+    r'(?P<key>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<value>(?:[^"\\]|\\.)*)"'
+)
+
+
+def _full_exposition():
+    """Every render section populated, including the awkward values:
+    label characters needing escaping, None (NaN) gauges, +Inf
+    histogram edges, multi-label series."""
+    timings = PhaseTimings()
+    timings.record("suggest", 0.5)
+    spec = SpeculationStats()
+    spec.record_dispatch(0.1)
+    spec.record_sync(0.2)
+    faults = FaultStats()
+    faults.record("lease_expired")
+    faults.record('chaos_torn_doc"quoted\\path')  # escaping exercise
+    faults.record_backoff(0.7)
+    service = ServiceStats()
+    service.record_request("suggest", seconds=0.02, study='s"tricky\\1')
+    service.record_request("suggest", seconds=7.0, study="s2", cold=True)
+    service.record_rejection("suggest")
+    service.record_error("report")
+    service.record_replay("suggest")
+    service.record_dispatch(4, 0.1)
+    service.record_phase("dispatch", 0.08)
+    service.record_compile(1024, "cont+idx")
+    service.record_inline(2)
+    service.set_queue_depth(3)
+    service.set_n_studies(2)
+    device = DeviceStats()
+    device.record_dispatch({
+        "sig": "h1024/cont", "device_s": 0.01, "n_requests": 4,
+        "binding_ceiling": "hbm_bw", "roofline_pct": 12.5,
+        "hbm_bytes": 1e6, "flops": 2e6, "live_bytes": 4096,
+        "compiled": False,
+    })
+    device.set_backend_peak_bytes(1 << 20)
+    store = StoreStats()
+    store.record_fsync(0.001, kind="doc", nbytes=512)
+    store.record_fsync(3.0, kind="journal", nbytes=128)  # +Inf bucket
+    store.record_doc_write(512)
+    store.record_attachment_write(64)
+    store.record_scan(10)
+    store.record_refresh(local=True)
+    store.record_refresh(local=False)
+    store.record_journal_append(128)
+    store.record_journal_compaction(1000)
+    store.record_journal_torn(1)
+    store.record_lease("grant")
+    store.record_quarantine(1)
+    study_health = {
+        "rows": [{
+            "study": 'zoo"1\\x', "best_loss": 0.5, "regret": None,
+            "gamma": 0.25, "n_below": 4, "ei_max": 1.5,
+            "ei_flatness": 0.3, "state": "OK",
+        }],
+        "truncated_total": 7,
+    }
+    slo_rows = [
+        {"rule": "SL601", "status": "ok", "burn_fast": 0.1,
+         "burn_slow": 0.05, "breaches_total": 0},
+        {"rule": "SL605", "status": "breach", "burn_fast": 2.0,
+         "burn_slow": None, "breaches_total": 3},
+    ]
+    return render_prometheus(
+        timings=timings, speculation=spec, faults=faults,
+        service=service, device=device, study_health=study_health,
+        store=store, slo=slo_rows, build=build_info(),
+        extra={"service_uptime_seconds": 12.5},
+    )
+
+
+def parse_exposition(text):
+    """Parse the exposition; raises AssertionError on any structural
+    violation.  Returns {metric_name: {"help", "type", "samples"}}
+    where samples is a list of (label_tuple, value)."""
+    families = {}
+    pending_help = {}
+    last_decl = None
+    assert text.endswith("\n"), "exposition must end with a newline"
+    for lineno, line in enumerate(text.splitlines(), 1):
+        assert line.strip() == line, f"line {lineno}: stray whitespace"
+        assert line, f"line {lineno}: blank line"
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            assert _NAME_RE.match(name), f"line {lineno}: bad name {name}"
+            assert help_text, f"line {lineno}: empty HELP"
+            pending_help[name] = help_text
+            last_decl = ("help", name)
+        elif line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            assert kind in ("counter", "gauge", "histogram", "summary"), (
+                f"line {lineno}: bad TYPE {kind!r}"
+            )
+            # HELP must immediately precede TYPE for the same metric
+            assert last_decl == ("help", name), (
+                f"line {lineno}: TYPE {name} without preceding HELP"
+            )
+            assert name not in families, (
+                f"line {lineno}: duplicate TYPE declaration for {name}"
+            )
+            families[name] = {
+                "help": pending_help[name], "type": kind, "samples": [],
+            }
+            last_decl = ("type", name)
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"line {lineno}: unparseable sample {line!r}"
+            name = m.group("name")
+            # histogram samples attach to their declared family
+            base = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix) and name[: -len(suffix)] in families:
+                    base = name[: -len(suffix)]
+            assert base in families, (
+                f"line {lineno}: sample {name} without HELP/TYPE"
+            )
+            labels = []
+            raw = m.group("labels")
+            if raw is not None:
+                consumed = 0
+                for pm in _LABEL_PAIR_RE.finditer(raw):
+                    key = pm.group("key")
+                    assert _LABEL_RE.match(key)
+                    labels.append((key, pm.group("value")))
+                    consumed += pm.end() - pm.start()
+                # everything between pairs must be separators (commas)
+                leftovers = _LABEL_PAIR_RE.sub("", raw).replace(",", "")
+                assert not leftovers, (
+                    f"line {lineno}: malformed labels {raw!r}"
+                )
+            value = m.group("value")
+            if value not in ("NaN", "+Inf", "-Inf"):
+                float(value)  # must parse
+            families[base]["samples"].append(
+                (name, tuple(sorted(labels)), value)
+            )
+            last_decl = None
+    return families
+
+
+class TestExpositionFormat:
+    def test_full_render_parses_with_no_duplicates(self):
+        text = _full_exposition()
+        families = parse_exposition(text)
+        # every family present once, with at least one sample
+        assert len(families) > 30
+        seen_series = set()
+        for fam, rec in families.items():
+            assert rec["samples"], f"{fam} declared but no samples"
+            for name, labels, _value in rec["samples"]:
+                key = (name, labels)
+                assert key not in seen_series, f"duplicate series {key}"
+                seen_series.add(key)
+
+    def test_every_stats_family_is_exposed(self):
+        families = parse_exposition(_full_exposition())
+        expected = {
+            # driver / speculation / faults
+            "hyperopt_phase_seconds_total",
+            "hyperopt_speculation_seconds_total",
+            "hyperopt_fault_events_total",
+            "hyperopt_fault_backoff_seconds_total",
+            # service
+            "hyperopt_service_requests_total",
+            "hyperopt_service_rejected_total",
+            "hyperopt_service_errors_total",
+            "hyperopt_service_idempotent_replays_total",
+            "hyperopt_service_suggest_duration_seconds",
+            "hyperopt_service_suggest_split_latency_ms",
+            "hyperopt_service_suggest_split_total",
+            "hyperopt_compile_events_total",
+            "hyperopt_service_batch_occupancy",
+            # device
+            "hyperopt_device_duty_cycle",
+            "hyperopt_device_roofline_pct",
+            "hyperopt_device_memory_highwater_bytes",
+            # study health
+            "hyperopt_study_best_loss",
+            "hyperopt_study_health",
+            "hyperopt_studies_truncated_total",
+            # store (new)
+            "hyperopt_store_fsyncs_total",
+            "hyperopt_store_fsync_duration_seconds",
+            "hyperopt_store_doc_writes_total",
+            "hyperopt_store_scans_total",
+            "hyperopt_store_refresh_total",
+            "hyperopt_store_journal_appends_total",
+            "hyperopt_store_journal_torn_lines_total",
+            "hyperopt_store_lease_events_total",
+            "hyperopt_store_quarantined_docs_total",
+            # slo (new)
+            "hyperopt_slo_status",
+            "hyperopt_slo_burn_rate",
+            "hyperopt_slo_breaches_total",
+            # identity (new)
+            "hyperopt_build_info",
+        }
+        missing = expected - set(families)
+        assert not missing, f"families missing from exposition: {missing}"
+
+    def test_counter_names_end_in_total(self):
+        families = parse_exposition(_full_exposition())
+        for fam, rec in families.items():
+            if rec["type"] == "counter":
+                assert fam.endswith("_total"), (
+                    f"counter {fam} must end in _total"
+                )
+
+    def test_histograms_are_monotone_and_closed(self):
+        families = parse_exposition(_full_exposition())
+        hists = [
+            fam for fam, rec in families.items()
+            if rec["type"] == "histogram"
+        ]
+        assert "hyperopt_service_suggest_duration_seconds" in hists
+        assert "hyperopt_store_fsync_duration_seconds" in hists
+        for fam in hists:
+            rec = families[fam]
+            buckets = [
+                (dict(labels)["le"], float(value))
+                for name, labels, value in rec["samples"]
+                if name == f"{fam}_bucket"
+            ]
+            count = [
+                float(value) for name, _, value in rec["samples"]
+                if name == f"{fam}_count"
+            ]
+            assert buckets and count
+            # cumulative counts monotone nondecreasing, +Inf last and
+            # equal to _count
+            values = [v for _, v in buckets]
+            assert values == sorted(values), (fam, values)
+            assert buckets[-1][0] == "+Inf"
+            assert buckets[-1][1] == count[0]
+            edges = [
+                float(le) for le, _ in buckets[:-1]
+            ]
+            assert edges == sorted(edges)
+
+    def test_label_escaping_round_trips(self):
+        text = _full_exposition()
+        families = parse_exposition(text)
+        studies = {
+            dict(labels).get("study")
+            for _, labels, _ in families[
+                "hyperopt_service_study_suggests_total"
+            ]["samples"]
+        }
+        # the escaped form is on the wire; unescaping recovers the
+        # original tricky id
+        tricky = next(s for s in studies if "tricky" in s)
+        unescaped = (
+            tricky.replace("\\\\", "\0")
+            .replace('\\"', '"')
+            .replace("\\n", "\n")
+            .replace("\0", "\\")
+        )
+        assert unescaped == 's"tricky\\1'
+
+    def test_build_info_identity_gauge(self):
+        families = parse_exposition(_full_exposition())
+        ((name, labels, value),) = families["hyperopt_build_info"][
+            "samples"
+        ]
+        keys = dict(labels)
+        assert set(keys) == {"version", "jax", "backend"}
+        assert float(value) == 1.0
+
+    def test_nan_renders_as_NaN_token(self):
+        families = parse_exposition(_full_exposition())
+        # SL605's burn_slow was None → NaN sample token, not 'None'
+        burns = {
+            (dict(labels)["rule"], dict(labels)["window"]): value
+            for _, labels, value in families["hyperopt_slo_burn_rate"][
+                "samples"
+            ]
+        }
+        assert burns[("SL605", "slow")] == "NaN"
+        assert not math.isnan(float(burns[("SL601", "fast")]))

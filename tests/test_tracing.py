@@ -161,6 +161,48 @@ class TestSampling:
             assert tracing.current_trace_id() is None
         assert tracer.summary()["n_begun"] == 0
 
+    def test_sample_zero_noop_survives_recorder_retention(self, monkeypatch):
+        """ISSUE-9 guard: the flight recorder's trace-ring retention
+        hooks Tracer.finish, and must NOT regress the sample-0 fast
+        path — with no trace bound, span() still returns the shared
+        singleton without allocating, locking, or reading a clock."""
+        from hyperopt_tpu.slo import FlightRecorder
+
+        recorder = FlightRecorder()
+        tracer = Tracer(sample=0.0)
+        tracer.set_recorder(recorder)
+        # off still means off: no traces begin, the ring stays empty
+        assert not tracer.enabled
+        assert tracer.begin() is None
+        assert tracer.finish(None) is False
+        assert recorder.summary()["n_buffered_traces"] == 0
+        # no clock read on the unbound span path: a poisoned monotonic
+        # clock would raise if span()/add_event() ever touched it
+        def poisoned():
+            raise AssertionError("unbound span path read the clock")
+
+        monkeypatch.setattr(tracing.time, "monotonic", poisoned)
+        with tracing.use_trace(None):
+            assert tracing.span("anything", k=1) is NULL_SPAN
+            assert tracing.add_event("anything") is NULL_SPAN
+        # no per-call allocation: the singleton is returned, not built
+        import tracemalloc
+
+        tracemalloc.start()
+        try:
+            tracing.span("hot")  # warm any lazy interning
+            before = tracemalloc.take_snapshot()
+            for _ in range(100):
+                tracing.span("hot")
+            after = tracemalloc.take_snapshot()
+        finally:
+            tracemalloc.stop()
+        grown = [
+            s for s in after.compare_to(before, "lineno")
+            if s.size_diff > 0 and "tracing.py" in str(s.traceback)
+        ]
+        assert not grown, grown
+
     def test_slow_threshold_alone_enables(self):
         tracer = Tracer(sample=0.0, slow_threshold_s=0.5)
         assert tracer.enabled
