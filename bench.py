@@ -436,9 +436,15 @@ def _tpu_smoke():
         raise RuntimeError(f"scorer precision smoke failed: max_err={err}")
     from hyperopt_tpu.ops import pallas_gmm
 
+    # report what the scorer paths will actually USE (the unified
+    # resolver), not the raw measured globals — None placeholders never
+    # reach the artifact, and the two paths can no longer diverge
+    # silently (the measured values still differ only when both were
+    # probed and disagreed; resolve_fma applies the single-probe
+    # fallback either way)
     return scorer, err, (
-        pallas_gmm._fma_measured_default,
-        pallas_gmm._fma_measured_default_unbatched,
+        pallas_gmm.resolve_fma("batched"),
+        pallas_gmm.resolve_fma("unbatched"),
     )
 
 
@@ -788,6 +794,45 @@ def slo_section(argv):
     return 0 if report["ok"] else 1
 
 
+def warmup_section(argv):
+    """``python bench.py --warmup [--quick]``: compile-plane smoke —
+    runs the cold-start vs warmed-restart A/B (scripts/warmup_report.py)
+    on CPU and writes ``WARMUP_SERVE.json`` (ledger-driven AOT warmup
+    covers the campaign's bucket x family grid before /readyz, zero
+    request-path compiles after ready on the warmed run with SL607
+    clean, kill -9 restart warmup a small fraction of the cold compile
+    cost via the persistent XLA cache, served_cold containment fully
+    trace-attributed, compile-plane overhead <5%).  A quick run writes
+    a separate file so CI can never clobber the committed full artifact
+    (the PR 7 convention).  Prints ONE JSON line like the other bench
+    sections."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    warmup_report = _import_script("warmup_report")
+    quick = "--quick" in argv
+    out_path = "WARMUP_SERVE.quick.json" if quick else "WARMUP_SERVE.json"
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    t0 = time.time()
+    report = warmup_report.run_report(quick=quick)
+    warmup_report.write_report(report, out_path)
+    out = {
+        "metric": "warmup_smoke",
+        "value": report["coverage"]["frac"],
+        "unit": "grid_coverage",
+        "ok": report["ok"],
+        "n_cold_after_ready": report["warmed"]["n_cold_after_ready"],
+        "restart_ratio": report["restart_ratio"]["warmed_over_cold"],
+        "served_cold_attributed": report["served_cold"]["attributed"],
+        "overhead_p50_regression_frac": (
+            report["overhead"]["p50_regression_frac"]
+        ),
+        "errors": report["errors"],
+        "elapsed_s": round(time.time() - t0, 2),
+    }
+    print(json.dumps(out))
+    return 0 if report["ok"] else 1
+
+
 def device_profile_section(argv):
     """``python bench.py --device-profile [--quick]``: device-plane
     observability smoke — runs the roofline-profiled suggest workload
@@ -838,6 +883,9 @@ def main():
     if "--study-health" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--study-health"]
         return study_health_section(argv)
+    if "--warmup" in sys.argv[1:]:
+        argv = [a for a in sys.argv[1:] if a != "--warmup"]
+        return warmup_section(argv)
     if "--device-profile" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--device-profile"]
         return device_profile_section(argv)

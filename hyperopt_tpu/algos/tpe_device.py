@@ -35,6 +35,7 @@ relies on.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 import weakref
@@ -555,6 +556,9 @@ def reset_device_state():
     cost as a bucket-boundary rebuild)."""
     _cache.clear()
     _jit_cache.clear()
+    # the warm-program set mirrors the executable caches: after a reset
+    # every program re-traces, so nothing is warm
+    _warm_keys.clear()
 
 
 # ---------------------------------------------------------------------
@@ -935,6 +939,98 @@ def _multi_sig(requests):
     )
 
 
+def args_shapes(args_list):
+    """((shape, dtype) per arg) per family — the trace observers'
+    shapes tuple, factored so the compile ledger
+    (:mod:`hyperopt_tpu.compile_ledger`) and the warm-program set name
+    a program exactly the way the observers do."""
+    return tuple(
+        tuple(
+            (tuple(a.shape), str(getattr(a, "dtype", "")))
+            for a in args
+        )
+        for args in args_list
+    )
+
+
+# Programs this PROCESS has already traced (and therefore compiled or
+# loaded from the persistent cache): ``(sig, shapes)`` keys added after
+# every fused launch.  ``is_warm`` is the request-path cold-compile
+# check — a dispatch whose key is absent will pay an XLA trace.
+# Mutations are single attribute ops (GIL-atomic); cleared with the
+# executable caches in ``reset_device_state``.
+_warm_keys = set()
+
+# Serializes COLD launches only (key absent from ``_warm_keys``): the
+# AOT warmup thread, a cold-containment background compile, and a
+# request-path dispatch can race the same novel program — without this
+# each would pay the full multi-second XLA trace+compile (the
+# ``_jit_cache`` check-then-set is unsynchronized) and double peak
+# memory during exactly the startup window warmup exists to smooth.
+# Warm launches never touch it.
+_cold_launch_lock = threading.Lock()
+
+# Thread-local marker for OFF-REQUEST-PATH compiles (the warmup driver
+# and the containment background thread): the service's compile
+# observer keeps these out of the request-cold attribution — a request
+# that merely OVERLAPS an off-thread compile never waited on it and
+# must not count against SL607.
+_bg_tls = threading.local()
+
+
+@contextlib.contextmanager
+def background_compiles():
+    """Mark this thread's fused launches as background (off the
+    request path) for the compile observers."""
+    prev = getattr(_bg_tls, "active", False)
+    _bg_tls.active = True
+    try:
+        yield
+    finally:
+        _bg_tls.active = prev
+
+
+def in_background_compiles() -> bool:
+    return bool(getattr(_bg_tls, "active", False))
+
+
+def program_key(requests):
+    """The warm-set identity of one fused request list."""
+    return (_multi_sig(requests),
+            args_shapes([args for _, args, _ in requests]))
+
+
+def is_warm(requests) -> bool:
+    """Has this process already traced the fused program ``requests``
+    would dispatch?  False means the next dispatch pays an XLA compile
+    (or a persistent-cache load) in whatever thread launches it."""
+    return program_key(requests) in _warm_keys
+
+
+def canonical_group_order(groups):
+    """The deterministic group ordering ``multi_study_suggest_async``
+    batches under (the jit key depends on request order — see its
+    docstring).  Exposed so callers can predict the exact fused
+    program a prospective batch would dispatch (the scheduler's
+    cold-containment check)."""
+    def canon_key(g):
+        return repr((
+            _multi_sig(g),
+            tuple(
+                tuple(np.shape(a) for a in args) for _, args, _ in g
+            ),
+        ))
+
+    return sorted(range(len(groups)), key=lambda i: canon_key(groups[i]))
+
+
+def fused_is_warm(groups) -> bool:
+    """``is_warm`` for the exact fused program a batch of ``groups``
+    would launch (canonical order applied first)."""
+    order = canonical_group_order(groups)
+    return is_warm([r for i in order for r in groups[i]])
+
+
 def compile_key(sig, shapes):
     """``(trial_count_bucket, families)`` of one fused-program trace
     event, from the ``(sig, shapes)`` a ``_trace_observers`` entry
@@ -974,13 +1070,7 @@ def _build_multi_run(requests):
         # it — reaching this line IS the retrace event
         _trace_tls.fired = True
         if _trace_observers:
-            shapes = tuple(
-                tuple(
-                    (tuple(a.shape), str(getattr(a, "dtype", "")))
-                    for a in args
-                )
-                for args in args_list
-            )
+            shapes = args_shapes(args_list)
             for obs in list(_trace_observers):
                 obs(sig, shapes)
         outs = [core(*a) for core, a in zip(cores, args_list)]
@@ -1037,18 +1127,33 @@ def multi_family_suggest_async(requests):
                     done_cbs = []
                 done_cbs.append(cb)
     sig = _multi_sig(requests)
-    fn = _jit_cache.get(("multi",) + sig)
-    if fn is None:
-        _, run = _build_multi_run(requests)
-        fn = jax.jit(run)
-        _jit_cache[("multi",) + sig] = fn
-    _trace_tls.fired = False
-    t_launch0 = time.perf_counter()
-    flat_dev = fn([args for _, args, _ in requests])
-    t_launch1 = time.perf_counter()
-    # read back synchronously on the launching thread: True iff THIS
-    # launch traced (and therefore compiled) the program
-    compiled = bool(getattr(_trace_tls, "fired", False))
+    key = program_key(requests)
+    # cold launches serialize (see _cold_launch_lock); the contextmanager
+    # shape keeps the warm fast path lock-free
+    cold_gate = (
+        _cold_launch_lock if key not in _warm_keys
+        else contextlib.nullcontext()
+    )
+    with cold_gate:
+        fn = _jit_cache.get(("multi",) + sig)
+        if fn is None:
+            _, run = _build_multi_run(requests)
+            fn = jax.jit(run)
+            _jit_cache[("multi",) + sig] = fn
+        _trace_tls.fired = False
+        t_launch0 = time.perf_counter()
+        # args containers normalized to tuples: the container type is
+        # part of the jit pytree key, and callers vary (prepare builds
+        # tuples, ledger replay/background clones could build lists) —
+        # one canonical structure keeps them all on one executable
+        flat_dev = fn([tuple(args) for _, args, _ in requests])
+        t_launch1 = time.perf_counter()
+        # read back synchronously on the launching thread: True iff THIS
+        # launch traced (and therefore compiled) the program
+        compiled = bool(getattr(_trace_tls, "fired", False))
+        # whatever the launch paid, the program is warm now — the key
+        # the cold-containment check and the warmup driver consult
+        _warm_keys.add(key)
 
     def resolve():
         t_read0 = time.perf_counter()
@@ -1138,18 +1243,10 @@ def multi_study_suggest_async(groups):
     in one batch and [B, A] in the next would recompile an identical
     workload (and grow the executable cache combinatorially).
     """
-    def canon_key(g):
-        # statics + arg shapes = the jit cache key contribution of one
-        # group; repr gives a total order without comparing the raw
-        # values (statics may hold non-orderable objects)
-        return repr((
-            _multi_sig(g),
-            tuple(
-                tuple(np.shape(a) for a in args) for _, args, _ in g
-            ),
-        ))
-
-    order = sorted(range(len(groups)), key=lambda i: canon_key(groups[i]))
+    # statics + arg shapes = the jit cache key contribution of each
+    # group; canonical_group_order totally orders them by repr (statics
+    # may hold non-orderable objects)
+    order = canonical_group_order(groups)
     flat = [r for i in order for r in groups[i]]
     resolve_all = multi_family_suggest_async(flat)
     cell = {}

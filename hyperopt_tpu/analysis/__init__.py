@@ -100,6 +100,11 @@ RACE_LINT_FILES = (
     # study's SearchStats while /metrics and /v1/study_status snapshot
     # it — every counter carries a guard
     os.path.join(_PKG_ROOT, "diagnostics.py"),
+    # compile-plane observability: dispatch callbacks append ledger
+    # records while the warmup thread replays them and /readyz //v1/
+    # warmup snapshot item states — ledger map and item list carry
+    # guards
+    os.path.join(_PKG_ROOT, "compile_ledger.py"),
 )
 
 

@@ -406,14 +406,26 @@ class ServiceStats:
         # dispatch/readback/finish/inline), fed by the scheduler
         self._phase_s = defaultdict(float)
         self._phase_n = defaultdict(int)
-        # XLA (re)compile events keyed by (trial-bucket, families)
+        # XLA (re)compile events keyed by (trial-bucket, families);
+        # request-path events counted separately (background warmup/
+        # containment compiles are excluded from cold attribution)
         self._compile_events = defaultdict(int)
+        self._n_request_compile_events = 0
         self._n_dispatches = 0        # fused device programs launched
         self._n_batched = 0           # suggests served through a dispatch
         self._n_inline = 0            # host-side suggests (startup/rand)
         self._dispatch_s = 0.0
         self._queue_depth = 0         # last-observed scheduler queue depth
         self._n_studies = 0
+        # compile-plane accounting (hyperopt_tpu.compile_ledger):
+        # cold suggests overall, cold suggests AFTER the service first
+        # reported ready (the SL607 numerator — post-warmup the request
+        # path must pay ~zero compiles), and host-side cold-containment
+        # fallbacks served while a compile proceeded off-thread
+        self._n_cold_suggests = 0
+        self._n_cold_after_ready = 0
+        self._n_cold_fallbacks = 0
+        self._ready = False           # latched by mark_ready()
 
     def record_request(self, endpoint: str, seconds=None, study=None,
                        replay=False, cold=False):
@@ -428,6 +440,10 @@ class ServiceStats:
             if endpoint == "suggest" and not replay:
                 if study is not None:
                     self._study_suggests[str(study)] += 1
+                if cold:
+                    self._n_cold_suggests += 1
+                    if self._ready:
+                        self._n_cold_after_ready += 1
                 if seconds is not None:
                     self._suggest_hist.observe(float(seconds))
                     split = (
@@ -436,6 +452,33 @@ class ServiceStats:
                     )
                     split.observe(float(seconds))
                     self._suggest_latencies.append(float(seconds))
+
+    def mark_ready(self):
+        """Latch "the service has reported ready": cold suggests from
+        here on count against SL607 (a compile in the request path
+        after warmup is the failure the warmup exists to prevent).
+
+        Armed by the first GREEN ``/readyz`` evaluation — deliberately:
+        an embedded service that is never readiness-probed keeps SL607
+        in ``no_data``, because without a readiness barrier its traffic
+        legitimately interleaves with first-touch compiles (a short
+        in-process campaign runs ~10% cold organically, and paging on
+        that would punish correct behavior).  Serving deployments
+        always probe ``/readyz`` (``wait_ready``, k8s), which is
+        exactly the population the rule guards."""
+        with self._lock:
+            self._ready = True
+
+    def record_cold_fallback(self):
+        """One suggest served host-side (cold containment) while its
+        unwarmed fused program compiled off-thread."""
+        with self._lock:
+            self._n_cold_fallbacks += 1
+
+    @property
+    def n_cold_fallbacks(self) -> int:
+        with self._lock:
+            return self._n_cold_fallbacks
 
     def record_rejection(self, endpoint: str):
         with self._lock:
@@ -467,16 +510,24 @@ class ServiceStats:
             self._phase_s[str(phase)] += float(seconds)
             self._phase_n[str(phase)] += int(n)
 
-    def record_compile(self, bucket, families):
+    def record_compile(self, bucket, families, background=False):
         """One XLA (re)trace of the fused suggest program, keyed by its
-        (trial-count bucket, family composition)."""
+        (trial-count bucket, family composition).  ``background=True``
+        marks an off-request-path compile (AOT warmup replay, cold-
+        containment background thread): counted in the per-key event
+        map but excluded from :attr:`n_compile_events`, so a request
+        that merely OVERLAPPED it is never attributed cold."""
         with self._lock:
             self._compile_events[(int(bucket), str(families))] += 1
+            if not background:
+                self._n_request_compile_events += 1
 
     @property
     def n_compile_events(self) -> int:
+        """Request-path compile events only (the cold-attribution
+        delta); the full per-key map is :meth:`compile_events`."""
         with self._lock:
-            return sum(self._compile_events.values())
+            return self._n_request_compile_events
 
     def record_inline(self, n: int = 1):
         """Suggests served host-side (random startup) — no device
@@ -557,6 +608,10 @@ class ServiceStats:
                     self._errors.get(e, 0) for e in mutating
                 ),
                 "errors_total": sum(self._errors.values()),
+                # compile-plane counters (SL607 + cold containment)
+                "suggests_cold": self._n_cold_suggests,
+                "suggests_cold_after_ready": self._n_cold_after_ready,
+                "cold_fallbacks": self._n_cold_fallbacks,
             }
 
     def window_quantiles(self):
@@ -630,6 +685,9 @@ class ServiceStats:
                 "dispatch_s": round(self._dispatch_s, 6),
                 "queue_depth": self._queue_depth,
                 "n_studies": self._n_studies,
+                "n_cold_suggests": self._n_cold_suggests,
+                "n_cold_after_ready": self._n_cold_after_ready,
+                "n_cold_fallbacks": self._n_cold_fallbacks,
                 # histogram-derived (all observations ever)
                 "suggest_latency": q,
                 # first-touch (compile-carrying) vs steady-state split

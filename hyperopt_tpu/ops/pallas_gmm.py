@@ -183,20 +183,49 @@ def set_default_fma(value: bool, kernel: str = "both") -> None:
         _fma_measured_default_unbatched = v
 
 
-def _default_fma(batched: bool = True) -> bool:
-    """Kernel-body default for the quadratic evaluation: VPU FMA vs MXU
-    dot. Resolution order: ``HYPEROPT_TPU_PALLAS_FMA=0/1`` env override,
-    then the per-kernel measured default (:func:`set_default_fma`,
-    written by the TPU timing probe), then the MXU path."""
+def resolve_fma(kernel: str = "batched") -> bool:
+    """THE kernel-mode resolver: VPU FMA vs MXU dot for the quadratic
+    evaluation, for ``kernel`` in ``{"batched", "unbatched"}``.  Both
+    public entry points (:func:`pair_score_pallas_batched` /
+    :func:`pair_score_pallas`) and every reporting surface (bench
+    smoke fields) resolve through this one function, so the default
+    can never silently diverge between the two scorer paths again
+    (the ROADMAP's ``pallas_fma_default`` inconsistency).
+
+    Resolution order:
+
+    1. ``HYPEROPT_TPU_PALLAS_FMA=0/1`` env override (both kernels);
+    2. THIS kernel's measured default (:func:`set_default_fma`,
+       written by the per-kernel TPU timing probe);
+    3. the OTHER kernel's measured default — a single-kernel probe
+       (or a partial ``set_default_fma`` call) applies to both paths
+       rather than leaving them split between measured-FMA and
+       silent-MXU;
+    4. the MXU path.
+    """
     import os
 
+    if kernel not in ("batched", "unbatched"):
+        raise ValueError(kernel)
     v = os.environ.get("HYPEROPT_TPU_PALLAS_FMA")
     if v is not None:
         return v.strip().lower() in ("1", "true", "yes", "on")
-    measured = _fma_measured_default if batched else _fma_measured_default_unbatched
-    if measured is not None:
-        return measured
+    own, other = (
+        (_fma_measured_default, _fma_measured_default_unbatched)
+        if kernel == "batched"
+        else (_fma_measured_default_unbatched, _fma_measured_default)
+    )
+    if own is not None:
+        return own
+    if other is not None:
+        return other
     return False
+
+
+def _default_fma(batched: bool = True) -> bool:
+    """Back-compat alias for :func:`resolve_fma` (kept for callers
+    that predate the unified resolver)."""
+    return resolve_fma("batched" if batched else "unbatched")
 
 
 def pair_score_pallas(
@@ -210,7 +239,7 @@ def pair_score_pallas(
     ``HYPEROPT_TPU_PALLAS_FMA`` mid-process takes effect on the next call
     (the resolved bool is the static cache key, never ``None``)."""
     if fma is None:
-        fma = _default_fma(batched=False)
+        fma = resolve_fma("unbatched")
     return _pair_score_pallas(z, params_pair, k_below, tc, tk, interpret, fma)
 
 
@@ -246,7 +275,7 @@ def pair_score_pallas_batched(
     → scores [L, C].  Grid is (labels × candidate tiles).  ``fma=None``
     resolves the env default outside jit (see ``pair_score_pallas``)."""
     if fma is None:
-        fma = _default_fma()
+        fma = resolve_fma("batched")
     return _pair_score_pallas_batched(z, params_pair, k_below, tc, tk, interpret, fma)
 
 

@@ -177,6 +177,10 @@ class FileWorker:
             )
             return False
         jobs.write(job)
+        # stop the heartbeat BEFORE releasing: a renewal racing the
+        # clear (read-lease before the unlink, write after it) would
+        # re-create the lease file and strand it for the reaper
+        heartbeat.stop()
         jobs.clear_lease(tid)
         jobs._unlock_if_owner(jobs.lock_path(tid), owner)
         return True

@@ -115,6 +115,32 @@ def make_parser():
              "counters, with store/duty rules reading no_data)",
     )
     p.add_argument(
+        "--compile-cache-dir", default=None, dest="compile_cache_dir",
+        help="persistent XLA program cache directory "
+             "(jax_compilation_cache_dir): a kill -9 restart re-pays "
+             "near-zero compile time (default <root>/xla_cache when "
+             "--root is set; pass 'none' to disable)",
+    )
+    p.add_argument(
+        "--no-warmup", action="store_true", dest="no_warmup",
+        help="skip the ledger-driven AOT compile warmup (/readyz then "
+             "gates only on recovery + fsck + the device probe, and "
+             "first-touch compiles land in the request path again)",
+    )
+    p.add_argument(
+        "--cold-fallback", action="store_true", dest="cold_fallback",
+        help="cold containment: serve a suggest whose fused program is "
+             "not yet compiled from the host-side startup path (tagged "
+             "served_cold) while the compile proceeds off-thread.  "
+             "Trades single-study trajectory determinism for tail "
+             "latency — off by default",
+    )
+    p.add_argument(
+        "--compile-ledger", default=None, dest="compile_ledger",
+        help="compile-ledger path (default <root>/compile_ledger.jsonl "
+             "when --root is set)",
+    )
+    p.add_argument(
         "--chaos-config", default=None, dest="chaos_config",
         help="TESTING ONLY: JSON ChaosConfig activating seeded "
              "service-plane fault injection (torn writes, connection "
@@ -173,6 +199,13 @@ def main(argv=None):
             "request tracing on: sample=%.3f slow_ms=%s log=%s",
             options.trace_sample, options.trace_slow_ms, trace_log,
         )
+    import os as _os
+
+    cache_dir = options.compile_cache_dir
+    if cache_dir is None and options.root:
+        cache_dir = _os.path.join(options.root, "xla_cache")
+    elif cache_dir and cache_dir.lower() == "none":
+        cache_dir = None
     service = OptimizationService(
         root=options.root,
         batch_window=options.batch_window,
@@ -182,6 +215,10 @@ def main(argv=None):
         tracer=tracer,
         slo_enabled=not options.no_slo,
         flight_dir=options.flight_dir,
+        compile_cache_dir=cache_dir,
+        warmup=not options.no_warmup,
+        cold_fallback=options.cold_fallback,
+        compile_ledger_path=options.compile_ledger,
     )
     # flight-recorder triggers beyond SLO breaches: SIGQUIT ("show me
     # what you were doing") and unhandled crashes (the post-mortem

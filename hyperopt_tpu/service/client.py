@@ -327,18 +327,41 @@ class ServiceClient:
     def wait_ready(self, timeout=60.0, poll=0.25) -> dict:
         """Poll ``/readyz`` until green (or raise TimeoutError) —
         transport errors (server still starting / mid-restart) count as
-        not-ready and keep polling."""
+        not-ready and keep polling.  While blocked, the 503 body's
+        warmup block is logged whenever it advances (``warmed/total``
+        buckets + ETA) so a long AOT warmup is visible progress, not a
+        silent hang."""
         deadline = time.monotonic() + float(timeout)
         last = None
+        last_progress = None
         while time.monotonic() < deadline:
             try:
                 last = self.readyz()
                 if last.get("ready"):
                     return last
+                wu = last.get("warmup") or {}
+                progress = (wu.get("warmed"), wu.get("total"))
+                if wu and progress != last_progress:
+                    last_progress = progress
+                    logger.info(
+                        "waiting for %s: warmup %s/%s buckets warm"
+                        "%s (device=%s, recovery_ok=%s)",
+                        self.base_url, wu.get("warmed"), wu.get("total"),
+                        (
+                            f", eta {wu['eta_s']:.1f}s"
+                            if wu.get("eta_s") else ""
+                        ),
+                        last.get("device"), last.get("recovery_ok"),
+                    )
             except _TRANSPORT_ERRORS:
                 pass
             time.sleep(poll)
         raise TimeoutError(f"service not ready after {timeout}s: {last}")
+
+    def warmup(self) -> dict:
+        """The ``GET /v1/warmup`` document (per-bucket AOT warmup
+        state + ETA + compile-ledger summary)."""
+        return self._request("GET", "/v1/warmup")
 
     def create_study(self, study_id, space, seed=0, algo="tpe",
                      algo_params=None, exist_ok=False,

@@ -1083,12 +1083,29 @@ class SuggestScheduler:
 
     def __init__(self, stats: ServiceStats = None, device_recovery=None,
                  batch_window=DEFAULT_BATCH_WINDOW,
-                 max_batch=DEFAULT_MAX_BATCH, max_queue=DEFAULT_MAX_QUEUE):
+                 max_batch=DEFAULT_MAX_BATCH, max_queue=DEFAULT_MAX_QUEUE,
+                 cold_fallback=False):
         self.batch_window = float(batch_window)
         self.max_batch = int(max_batch)
         self.max_queue = int(max_queue)
         self.stats = stats if stats is not None else ServiceStats()
         self.device_recovery = device_recovery
+        # cold containment (OFF by default — it trades trajectory
+        # determinism for tail latency): when the fused program a batch
+        # would dispatch has not been traced yet, serve the batch from
+        # the host-side startup path (random suggest) tagged
+        # ``served_cold`` while the compile proceeds on a background
+        # thread, so the NEXT request finds the program warm
+        self.cold_fallback = bool(cold_fallback)
+        self._bg_lock = threading.Lock()
+        self._bg_compiling = set()  # guarded-by: _bg_lock (program keys)
+        # per-program background-compile failure counts: past the
+        # budget, containment STOPS for that program and the batch
+        # dispatches normally, so the compile error surfaces to the
+        # requests (and the recovery layer) instead of degrading the
+        # study to random suggests forever
+        self._bg_failures = {}  # guarded-by: _bg_lock
+        self.max_bg_compile_failures = 3
         self._queue_cv = threading.Condition()
         self._queue = deque()  # guarded-by: _queue_cv
         self._draining = False  # guarded-by: _queue_cv
@@ -1307,8 +1324,31 @@ class SuggestScheduler:
                 finishes.append((p, prep[1], t_prep1))
         if not finishes:
             return
-        t0 = time.perf_counter()
         from ..algos import tpe_device
+
+        if self.cold_fallback:
+            order = tpe_device.canonical_group_order(groups)
+            flat = [r for i in order for r in groups[i]]
+            if not tpe_device.is_warm(flat):
+                with self._bg_lock:
+                    poisoned = self._bg_failures.get(
+                        tpe_device.program_key(flat), 0
+                    ) >= self.max_bg_compile_failures
+                if not poisoned:
+                    # cold containment: the fused program this batch
+                    # needs is untraced — dispatching it would park
+                    # every member behind an XLA compile.  Serve them
+                    # all host-side (tagged served_cold) and compile
+                    # off-thread instead.  A program whose background
+                    # compile keeps failing is NOT contained again: the
+                    # batch dispatches normally so the error reaches
+                    # the requests instead of silently degrading the
+                    # study to random suggests forever.
+                    self._spawn_background_compile(flat)
+                    for p, _finish, _t in finishes:
+                        self._serve_cold_fallback(p)
+                    return
+        t0 = time.perf_counter()
 
         # the batch LEADER's trace is bound for the fused launch: an XLA
         # retrace fired here (via the tpe_device trace observers) becomes
@@ -1431,6 +1471,97 @@ class SuggestScheduler:
             self.stats.record_phase("finish", time.monotonic() - t_f0)
             self._complete(p, docs, payload=payload)
 
+    # -- cold containment ----------------------------------------------
+    def _serve_cold_fallback(self, p: _PendingSuggest):
+        """Serve one pending from the host-side startup path (random
+        suggest at the study's already-drawn seed) while its fused
+        program compiles off-thread.  The trial is real and committed;
+        the trace root carries ``served_cold=true`` and the fallback is
+        counted (``hyperopt_service_cold_fallbacks_total``)."""
+        from ..algos import rand
+
+        study = p.study
+        t0 = time.monotonic()
+        try:
+            with tracing.use_trace(p.trace, parent=p.parent_span):
+                with tracing.span("suggest.cold_fallback"):
+                    with study.lock:
+                        docs = rand.suggest(
+                            p.ids, study.domain, study.trials, p.seed
+                        )
+                        payload = study.commit_suggest(
+                            docs, p.draw_index,
+                            idempotency_key=p.idempotency_key,
+                        )
+        except Exception as e:
+            logger.exception(
+                "cold-fallback suggest for study %r failed",
+                study.study_id,
+            )
+            self._fail(p, e)
+            return
+        if p.trace is not None and p.parent_span is not None:
+            p.parent_span.set_attr("served_cold", True)
+        self.stats.record_cold_fallback()
+        self.stats.record_inline()
+        self.stats.record_phase("cold_fallback", time.monotonic() - t0)
+        study.search_stats.record_suggest(None)
+        self._complete(p, docs, payload=payload)
+
+    def _spawn_background_compile(self, flat_requests):
+        """Compile the fused program for ``flat_requests`` on a daemon
+        thread, against ZERO-FILLED clones of the arguments (the live
+        device buffers may be donated away by a history append before
+        this thread dispatches — dummy args reproduce the identical
+        jit cache key with no aliasing hazard).  Deduplicated per
+        program key; errors are logged, never raised."""
+        from ..algos import tpe_device
+
+        key = tpe_device.program_key(flat_requests)
+        with self._bg_lock:
+            if key in self._bg_compiling:
+                return
+            self._bg_compiling.add(key)
+        clones = [
+            (
+                kind,
+                # a tuple, like suggest_prepare's args: the container
+                # type is part of the jit pytree key
+                tuple(
+                    np.zeros(np.shape(a), dtype=a.dtype) for a in args
+                ),
+                statics,
+            )
+            for kind, args, statics in flat_requests
+        ]
+
+        def compile_it():
+            try:
+                def dispatch():
+                    tpe_device.multi_family_suggest_async(clones)()
+
+                with tpe_device.background_compiles():
+                    if self.device_recovery is not None:
+                        self.device_recovery.run(dispatch)
+                    else:
+                        dispatch()
+            except Exception:
+                logger.exception("background cold compile failed")
+                with self._bg_lock:
+                    self._bg_failures[key] = (
+                        self._bg_failures.get(key, 0) + 1
+                    )
+            else:
+                with self._bg_lock:
+                    self._bg_failures.pop(key, None)
+            finally:
+                with self._bg_lock:
+                    self._bg_compiling.discard(key)
+
+        threading.Thread(
+            target=compile_it, name="hyperopt-cold-compile", daemon=True
+        ).start()
+
     # -- drain / shutdown ----------------------------------------------
     def drain(self, timeout=60.0):
         """Stop admitting, then wait for the queue and any in-flight
@@ -1473,8 +1604,36 @@ class OptimizationService:
                  fault_stats=None, startup_fsck=True, tracer=None,
                  metrics_max_studies=DEFAULT_METRICS_MAX_STUDIES,
                  slo_enabled=True, slo_rules=None, flight_dir=None,
-                 slo_tick=None):
+                 slo_tick=None, compile_cache_dir=None, warmup=True,
+                 cold_fallback=False, compile_ledger_path=None,
+                 compile_plane=True):
         self.stats = ServiceStats()
+        # compile plane (hyperopt_tpu.compile_ledger) — wired FIRST so
+        # the persistent XLA cache covers every compile this process
+        # pays (the warmup replay included) and the ledger recorder
+        # sees the earliest dispatches.  compile_plane=False is the
+        # full off switch (no recorder, no cache, no warmup) — the
+        # overhead A/B's baseline arm, mirroring slo_enabled=False.
+        from .. import compile_ledger as ledger_mod
+
+        self.compile_plane = bool(compile_plane)
+        if not self.compile_plane:
+            compile_cache_dir = None
+            compile_ledger_path = None
+            warmup = False
+        if compile_cache_dir:
+            ledger_mod.enable_persistent_cache(compile_cache_dir)
+        self.compile_cache_dir = compile_cache_dir
+        if compile_ledger_path is None and root and self.compile_plane:
+            compile_ledger_path = os.path.join(
+                os.path.abspath(root), ledger_mod.LEDGER_FILENAME
+            )
+        self.compile_ledger = ledger_mod.CompileLedger(compile_ledger_path)
+        self.ledger_recorder = ledger_mod.CompileLedgerRecorder(
+            self.compile_ledger
+        )
+        if self.compile_plane:
+            self.ledger_recorder.install()
         # storage-plane telemetry, installed process-wide BEFORE the
         # startup fsck and registry recovery so their scans and journal
         # loads are on the record too (latest-installed wins when
@@ -1532,6 +1691,17 @@ class OptimizationService:
             self._recovery_ok = False
         # the gauge must reflect RECOVERED studies too, not just creates
         self.stats.set_n_studies(len(self.registry))
+        # ledger-driven AOT warmup: replay the compile grid (ledger
+        # records + a dry-prepare probe per recovered study) through
+        # the real dispatch path off-thread; /readyz gates on FINISHED
+        # (errors are reported, never allowed to wedge readiness)
+        self.warmup = ledger_mod.WarmupDriver(
+            ledger=self.compile_ledger,
+            studies=self.registry.studies(),
+            device_recovery=self.device_recovery,
+            enabled=bool(warmup),
+        )
+        self.warmup.start()
         # SLO guardrails + flight recorder: the component that WATCHES
         # the three telemetry pillars.  The recorder's rings are push
         # (every finished trace) + pull (evidence providers read only
@@ -1585,6 +1755,7 @@ class OptimizationService:
             batch_window=batch_window,
             max_batch=max_batch,
             max_queue=max_queue,
+            cold_fallback=cold_fallback,
         )
         self.suggest_timeout = float(suggest_timeout)
         self.started_at = time.time()
@@ -1603,7 +1774,13 @@ class OptimizationService:
 
         def _on_program_trace(sig, shapes):
             bucket, families = tpe_device.compile_key(sig, shapes)
-            stats.record_compile(bucket, families)
+            stats.record_compile(
+                bucket, families,
+                # warmup replays and containment background compiles
+                # are real events but not request-path cold: a request
+                # overlapping one never waited on it
+                background=tpe_device.in_background_compiles(),
+            )
             tracing.add_event(
                 "compile", bucket=int(bucket), families=families,
             )
@@ -1895,6 +2072,8 @@ class OptimizationService:
             "fsck": self.fsck_report,
             "tracing": self.tracer.summary(),
             "flight_recorder": self.flight_recorder.summary(),
+            "warmup": self.warmup.progress_brief(),
+            "compile_ledger": self.compile_ledger.summary(),
         }
 
     def alerts(self) -> dict:
@@ -1923,26 +2102,46 @@ class OptimizationService:
 
     def readiness(self) -> dict:
         """The /readyz document: ready iff the registry recovered every
-        study, the startup fsck left the store clean, and the device
-        answered its warm probe (possibly via the CPU fallback)."""
+        study, the startup fsck left the store clean, the device
+        answered its warm probe (possibly via the CPU fallback), and
+        the AOT compile warmup finished (finished, not flawless — an
+        errored bucket is reported in the warmup block, not allowed to
+        wedge readiness; see :class:`~hyperopt_tpu.compile_ledger
+        .WarmupDriver`).  The 503 body carries warmup progress
+        (``warmed/total`` + ETA) so a blocked ``wait_ready`` log is
+        actionable."""
         with self._ready_lock:
             if self._device_state == "cold":
                 self._device_state = self._warm_device()
             device_state = self._device_state
+        warmup = self.warmup.progress_brief()
         ready = (
             self._recovery_ok
             and device_state in ("warm", "fallback")
+            and warmup["finished"]
             and not self._closed
         )
+        if ready:
+            # latch for SL607: cold suggests from here on are request-
+            # path compiles the warmup should have pre-paid
+            self.stats.mark_ready()
         return {
             "ready": ready,
             "draining": self._closed,
             "recovery_ok": self._recovery_ok,
             "device": device_state,
+            "warmup": warmup,
             "studies": len(self.registry),
             "recovery": dict(self.registry.recovery_info),
             "fsck": self.fsck_report,
         }
+
+    def warmup_status(self) -> dict:
+        """The ``GET /v1/warmup`` document: per-bucket warmup state
+        (pending/compiling/warm/skipped/error), ETA from ledger
+        durations, and the ledger summary."""
+        self.stats.record_request("warmup")
+        return self.warmup.status()
 
     def _study_health_rows(self):
         """The bounded per-study gauge rows: top-N studies by last
@@ -1961,9 +2160,37 @@ class OptimizationService:
         return [s.search_stats.metrics_row() for s in cut], total
 
     def metrics_text(self) -> str:
+        from .. import compile_ledger as ledger_mod
         from ..observability import build_info, render_prometheus
 
         rows, truncated = self._study_health_rows()
+        # compile-plane gauges: warmup progress + persistent-cache
+        # effectiveness + ledger size (flat gauges — the per-bucket
+        # detail lives at GET /v1/warmup)
+        wu = self.warmup.counts()
+        extra = {
+            "service_uptime_seconds": time.time() - self.started_at,
+            "compile_warmup_total": sum(wu.values()),
+            "compile_warmup_warm": wu[ledger_mod.STATE_WARM],
+            "compile_warmup_pending": (
+                wu[ledger_mod.STATE_PENDING]
+                + wu[ledger_mod.STATE_COMPILING]
+            ),
+            "compile_warmup_skipped": wu[ledger_mod.STATE_SKIPPED],
+            "compile_warmup_errors": wu[ledger_mod.STATE_ERROR],
+            "compile_warmup_finished": 1 if self.warmup.finished else 0,
+            "compile_ledger_entries": len(self.compile_ledger),
+            "compile_cache_hits_total": (
+                ledger_mod.cache_event_counts()["hits"]
+            ),
+            "compile_cache_misses_total": (
+                ledger_mod.cache_event_counts()["misses"]
+            ),
+            "service_cold_fallbacks_total": self.stats.n_cold_fallbacks,
+        }
+        eta = self.warmup.progress_brief()["eta_s"]
+        if eta is not None:
+            extra["compile_warmup_eta_seconds"] = eta
         return render_prometheus(
             timings=self.timings,
             faults=self.fault_stats,
@@ -1973,7 +2200,7 @@ class OptimizationService:
             store=self.store_stats,
             slo=self.slo.metrics_rows() if self.slo_enabled else None,
             build=build_info(),
-            extra={"service_uptime_seconds": time.time() - self.started_at},
+            extra=extra,
         )
 
     # -- lifecycle -----------------------------------------------------
@@ -1988,7 +2215,9 @@ class OptimizationService:
         self._closed = True
         self.scheduler.close(timeout=timeout)
         self.slo.close()
+        self.warmup.stop()
         self._uninstall_compile_observer()
+        self.ledger_recorder.uninstall()
         self.device_profiler.uninstall()
         if self.tracer is not tracing.DISABLED:
             self.tracer.set_recorder(None)

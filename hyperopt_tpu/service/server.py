@@ -19,6 +19,11 @@ API (all JSON unless noted)::
                                           multi-window burn rates,
                                           breaching subset, flight-
                                           recorder state)
+    GET  /v1/warmup                       AOT compile-warmup progress:
+                                          per-bucket state (pending/
+                                          compiling/warm/skipped/error),
+                                          ETA from ledger durations,
+                                          compile-ledger summary
     GET  /v1/studies                      {"studies": [id, ...]}
     GET  /v1/studies/<id>                 study status document
     POST /v1/studies                      create: {"study_id", "space_b64",
@@ -203,6 +208,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, self.service.service_status())
             elif path == "/v1/alerts":
                 self._send(200, self.service.alerts())
+            elif path == "/v1/warmup":
+                self._send(200, self.service.warmup_status())
             elif path == "/v1/studies":
                 self._send(200, {"studies": self.service.list_studies()})
             elif path.startswith("/v1/studies/"):

@@ -47,6 +47,8 @@ SL604     duty_cycle          device duty cycle ≥ floor while under load
 SL605     store_clean         zero torn journal lines / quarantined docs,
                               startup fsck clean (zero-tolerance)
 SL606     fsync_latency       99% of storage-plane fsyncs ≤ bound_s
+SL607     cold_compile        ~zero compile-carrying suggests after ready
+                              (the AOT-warmup closed-loop guard)
 ========  ==================  =============================================
 
 ``no_data`` (too few observations in a window) never breaches: silence
@@ -363,6 +365,63 @@ class FsyncLatencyRule(SloRule):
         )
 
 
+class ColdCompileRule(SloRule):
+    """SL607: the cold-compile rate in the request path stays ≈ 0
+    AFTER the service first reported ready — the closed-loop guard
+    over the AOT warmup (:mod:`hyperopt_tpu.compile_ledger`): a
+    post-ready cold suggest means the warmup grid missed a program the
+    traffic needed.  A small budget (default 1% of suggests) tolerates
+    the unavoidable first-touch of a study CREATED after startup
+    (warmup cannot predict a study that does not exist yet) without
+    letting a compile storm hide; a fully warmed restart must sit at
+    exactly zero.  Note the cold attribution is per REQUEST (PR 9
+    semantics): every batch member that waited on the compile counts,
+    so one first-touch under heavy batching costs ~batch_size budget —
+    intentionally, because each of those requests really paid the
+    multi-second tail; ``--cold-fallback`` containment is the remedy
+    that keeps them out of the numerator entirely.  Compiles before readiness are warmup's own business
+    and never counted, and the rule only ARMS on the first green
+    ``/readyz`` (``ServiceStats.mark_ready``): an embedded service
+    that is never readiness-probed stays ``no_data`` by design —
+    without a readiness barrier, traffic interleaving with first-touch
+    compiles is correct behavior, not an SLO violation.  Off-request-
+    path compiles (warmup replays, cold-containment background
+    threads) are excluded from the numerator at the attribution layer
+    (``tpe_device.background_compiles``)."""
+
+    rule_id = "SL607"
+    name = "cold_compile"
+    description = (
+        "compile-carrying (cold) suggests after /readyz first reported "
+        "ready stay within budget of suggest traffic (~0)"
+    )
+
+    def __init__(self, budget=0.01, min_requests=20):
+        self.budget = float(budget)
+        self.min_requests = int(min_requests)
+
+    def objective(self):
+        return {"budget": self.budget, "min_requests": self.min_requests}
+
+    def eval_window(self, win, absolute):
+        bad = win.counter("suggests_cold_after_ready")
+        total = win.counter("requests_suggest")
+        if total < self.min_requests:
+            if bad:
+                # a cold suggest in a quiet window must not hide behind
+                # the traffic floor: evaluate against the floor itself
+                total = self.min_requests
+            else:
+                return None, None, (
+                    f"{total:g} suggests (< {self.min_requests})"
+                )
+        frac = bad / total
+        return frac / self.budget, frac, (
+            f"{bad:g}/{total:g} post-ready suggests carried an XLA "
+            f"compile (budget {self.budget:.0%})"
+        )
+
+
 def default_rules(**overrides) -> list:
     """The SL6xx catalog with default objectives.  ``overrides`` maps
     rule name → kwargs dict (e.g. ``latency_ratio={"ratio_max": 10}``)."""
@@ -373,6 +432,7 @@ def default_rules(**overrides) -> list:
         ("duty_cycle", DutyCycleRule),
         ("store_clean", StoreCleanRule),
         ("fsync_latency", FsyncLatencyRule),
+        ("cold_compile", ColdCompileRule),
     )
     unknown = set(overrides) - {name for name, _ in builders}
     if unknown:

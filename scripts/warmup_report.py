@@ -1,0 +1,469 @@
+"""Cold start vs warmed restart A/B for the compile plane — the
+ISSUE-10 acceptance artifact (``WARMUP_SERVE.json``).
+
+Two REAL server subprocesses over one durable root with a persistent
+XLA program cache and the compile ledger:
+
+1. **Cold start** — a fresh root: the campaign's bucket×family program
+   grid compiles first-touch (containment on: unwarmed batches are
+   served host-side, tagged ``served_cold``, while compiles proceed
+   off-thread).  The ledger records every compile with its duration.
+2. ``kill -9`` mid-campaign, then **warmed restart** — the new process
+   replays the ledger grid through the real dispatch path behind
+   ``/readyz`` (programs load from the persistent cache), and the
+   campaign's remaining trials run with ZERO request-path compiles.
+
+Every guard is **structural** (ratios, coverage fractions, counts) —
+never absolute milliseconds: sandbox latency legitimately swings ~30×
+between sessions, but within ONE run the cold and warmed measurements
+co-vary.
+
+Report fields the artifact guard pins:
+
+- ``coverage.frac`` — warmup items warmed before ready, as a fraction
+  of the cold campaign's observed compile grid (≥ 0.95);
+- ``warmed.n_cold_after_ready`` == 0 and SL607 ``breaches_total`` == 0
+  on the warmed run (zero request-path compiles after ready);
+- ``restart_ratio.warmed_over_cold`` — warmup replay seconds over the
+  cold run's total ledger compile seconds (a small fraction);
+- ``served_cold.attributed`` — every host-side containment fallback is
+  trace-tagged ``served_cold=true`` (sampled at 1.0, so equality);
+- ``overhead.p50_regression_frac`` — compile-plane-on steady-state p50
+  within 5% of the compile-plane-off baseline (in-process A/B);
+- ``warm_tail.ok`` on both runs — warm (steady-state) p99 within the
+  platform-calibrated multiple of warm p50 (the ROADMAP acceptance).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/warmup_report.py [--quick] \
+        [--out WARMUP_SERVE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+ALGO_PARAMS = {"n_startup_jobs": 2, "n_EI_candidates": 64}
+# warm-tail calibration mirrors serve_loadgen's SLO gate: CPU-backend
+# fused dispatches legitimately run ~seconds under contention
+WARM_RATIO_MAX = {"tpu": 25.0, "cpu": 100.0}
+
+
+def _space():
+    from hyperopt_tpu import hp
+
+    return {
+        "x": hp.uniform("x", -5, 5),
+        "lr": hp.loguniform("lr", -5, 0),
+        "w": hp.quniform("w", 0, 10, 1),
+        "c": hp.choice("c", ["a", "b", "d"]),
+    }
+
+
+def _objective(point, rng):
+    return (
+        (point["x"] - 1.0) ** 2
+        + (np.log(point["lr"]) + 2.0) ** 2
+        + 0.1 * point["w"]
+        + (0.5 if point["c"] == "b" else 0.0)
+        + float(rng.normal()) * 0.01
+    )
+
+
+class Server:
+    """One server subprocess with the compile plane fully on."""
+
+    def __init__(self, root, port, log_dir, tag):
+        self.root = root
+        self.port = port
+        self.log_dir = log_dir
+        self.tag = tag
+        self.proc = None
+
+    def _env(self):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [REPO] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+        )
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        return env
+
+    def spawn(self):
+        log = open(
+            os.path.join(self.log_dir, f"server.{self.tag}.log"), "wb"
+        )
+        self.proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "hyperopt_tpu.service",
+                "--root", self.root,
+                "--port", str(self.port),
+                "--batch-window", "0.002",
+                "--cold-fallback",
+                "--trace-sample", "1.0",
+                "--log-level", "INFO",
+            ],
+            env=self._env(), cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=log,
+        )
+        return self
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}"
+
+    def kill9(self):
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=30)
+
+    def stop(self):
+        if self.proc is None or self.proc.poll() is not None:
+            return
+        self.proc.send_signal(signal.SIGTERM)
+        try:
+            self.proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+
+
+def _drive_concurrent(client_for, space, sids, n_trials, seed):
+    from hyperopt_tpu.fmin import space_eval
+
+    errors = []
+
+    def drive(idx, sid):
+        try:
+            client = client_for()
+            rng = np.random.default_rng(seed * 100 + idx)
+            for _ in range(n_trials):
+                (t,) = client.suggest(sid)
+                point = space_eval(space, t["vals"])
+                client.report(sid, t["tid"], loss=_objective(point, rng))
+        except Exception as e:
+            errors.append(f"{sid}: {e!r}")
+
+    threads = [
+        threading.Thread(target=drive, args=(i, sid), daemon=True)
+        for i, sid in enumerate(sids)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=900)
+    if any(t.is_alive() for t in threads):
+        errors.append("campaign thread timed out")
+    return errors
+
+
+def _drive_serial(client, space, sids, n_trials, seed):
+    from hyperopt_tpu.fmin import space_eval
+
+    errors = []
+    rng = np.random.default_rng(seed + 999)
+    for sid in sids:
+        try:
+            for _ in range(n_trials):
+                (t,) = client.suggest(sid)
+                point = space_eval(space, t["vals"])
+                client.report(sid, t["tid"], loss=_objective(point, rng))
+        except Exception as e:
+            errors.append(f"{sid}: {e!r}")
+    return errors
+
+
+def _warm_tail(stats, platform):
+    warm = stats["suggest_latency_warm"]
+    p50, p99 = warm["p50_ms"], warm["p99_ms"]
+    bound = WARM_RATIO_MAX[platform if platform in WARM_RATIO_MAX else "cpu"]
+    ratio = (p99 / p50) if p50 and p99 else None
+    return {
+        "warm_p50_ms": p50,
+        "warm_p99_ms": p99,
+        "n_warm": warm["count"],
+        "ratio": round(ratio, 2) if ratio is not None else None,
+        "ratio_max": bound,
+        # no warm traffic yet (or a floor-level p50) reads ok=None —
+        # the artifact guard requires ok is not False
+        "ok": (ratio <= bound) if ratio is not None else None,
+    }
+
+
+def _served_cold_from_traces(trace_log):
+    from hyperopt_tpu.tracing import read_trace_log
+
+    if not os.path.exists(trace_log):
+        return 0
+    # read_trace_log folds in the one-deep rotated sibling itself
+    records, _torn = read_trace_log(trace_log)
+    return sum(
+        1 for rec in records
+        if (rec.get("root_attrs") or {}).get("served_cold")
+    )
+
+
+def _sl607(alerts):
+    for row in alerts["rules"]:
+        if row["rule"] == "SL607":
+            return row
+    return None
+
+
+def run_report(quick=False, seed=0, workdir=None):
+    from hyperopt_tpu.service import ServiceClient
+    from hyperopt_tpu.service.server import free_port
+
+    space = _space()
+    n_studies = 3 if quick else 4
+    # phase-1 trial counts end INSIDE the final power-of-two history
+    # bucket so phase 2 (post-restart) stays within it — the warmed
+    # restart then needs zero new programs beyond the replayed grid
+    phase1_concurrent = 5 if quick else 11
+    phase2_trials = 1 if quick else 3
+    workdir = workdir or tempfile.mkdtemp(prefix="hyperopt-warmup-")
+    root = os.path.join(workdir, "root")
+    os.makedirs(root, exist_ok=True)
+    port = free_port()
+    sids = [f"warm-{i}" for i in range(n_studies)]
+    errors = []
+
+    # ---- phase 1: cold start --------------------------------------
+    server = Server(root, port, workdir, "cold").spawn()
+    t_spawn = time.monotonic()
+    client = ServiceClient(server.url, timeout=120)
+    client.wait_ready(timeout=300)
+    cold_ready_s = time.monotonic() - t_spawn
+    for i, sid in enumerate(sids):
+        client.create_study(
+            sid, space, seed=seed * 1000 + i, algo="tpe",
+            algo_params=ALGO_PARAMS,
+        )
+    errors += _drive_concurrent(
+        lambda: ServiceClient(server.url, timeout=120), space, sids,
+        phase1_concurrent, seed,
+    )
+    # serial coda: one solo suggest per study at the final bucket, so
+    # the single-study program composition phase 2 will use is in the
+    # ledger before the kill
+    errors += _drive_serial(client, space, sids, 1, seed)
+    # ledger records land at dispatch COMPLETION (compile events fire
+    # at trace time) — wait until every observed compile has its
+    # ledger record before the kill, or the warmup grid under-covers
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        status_cold = client.service_status()
+        n_events = sum(status_cold["stats"]["compile_events"].values())
+        if status_cold["compile_ledger"][
+            "recorded_this_process"
+        ] >= n_events:
+            break
+        time.sleep(0.25)
+    status_cold = client.service_status()
+    alerts_cold = client.alerts()
+    cold_stats = status_cold["stats"]
+    cold_ledger = status_cold["compile_ledger"]
+    campaign_grid = sorted(cold_stats["compile_events"])
+    server.kill9()
+    killed_at = time.monotonic()
+
+    # ---- phase 2: warmed restart ----------------------------------
+    server2 = Server(root, port, workdir, "warm").spawn()
+    t_spawn2 = time.monotonic()
+    client2 = ServiceClient(server2.url, timeout=120)
+    ready_doc = client2.wait_ready(timeout=600)
+    warmed_ready_s = time.monotonic() - t_spawn2
+    warmup_doc = client2.warmup()
+    warmed_keys = sorted({
+        f"{i['bucket']}/{i['families']}"
+        for i in warmup_doc["items"] if i["state"] == "warm"
+    })
+    covered = [k for k in campaign_grid if k in warmed_keys]
+    coverage_frac = (
+        len(covered) / len(campaign_grid) if campaign_grid else None
+    )
+    errors += _drive_serial(client2, space, sids, phase2_trials, seed)
+    status_warm = client2.service_status()
+    alerts_warm = client2.alerts()
+    warm_stats = status_warm["stats"]
+    platform = status_warm["version"]["backend"]
+    server2.stop()
+    restart_dead_s = round(t_spawn2 - killed_at, 3)
+
+    # ---- attribution + ratios -------------------------------------
+    n_fallbacks = (
+        cold_stats["n_cold_fallbacks"] + warm_stats["n_cold_fallbacks"]
+    )
+    n_tagged = _served_cold_from_traces(os.path.join(root, "trace.jsonl"))
+    warmup_replay_s = warmup_doc.get("elapsed_s")
+    cold_compile_s = cold_ledger["total_compile_s"]
+    ratio = (
+        round(warmup_replay_s / cold_compile_s, 4)
+        if warmup_replay_s is not None and cold_compile_s else None
+    )
+    sl607_warm = _sl607(alerts_warm)
+    warm_tail_cold = _warm_tail(cold_stats, platform)
+    warm_tail_warm = _warm_tail(warm_stats, platform)
+
+    # ---- overhead A/B (in-process, exact p50s, min-of-pairs) -------
+    scripts_dir = os.path.dirname(os.path.abspath(__file__))
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    import serve_loadgen
+
+    on_p50s, off_p50s = [], []
+    ab_trials = 6 if quick else 10
+    # two throwaway passes first: in-process programs (and the delta-
+    # append programs of a fresh history) compile here, so neither
+    # timed arm pays first-touch; pairs ALTERNATE order (a fixed order
+    # correlates each arm with drifting system load) and min-of-runs
+    # is the noise-robust estimator (jitter only ever adds time)
+    for _ in range(2):
+        serve_loadgen.run_loadgen(
+            n_studies=4, n_trials=ab_trials, seed=seed
+        )
+    for i in range(3):
+        arms = ("off", "on") if i % 2 == 0 else ("on", "off")
+        for arm in arms:
+            kwargs = (
+                {} if arm == "on"
+                else {"service_kwargs": {"compile_plane": False}}
+            )
+            r = serve_loadgen.run_loadgen(
+                n_studies=4, n_trials=ab_trials, seed=seed, **kwargs
+            )
+            (on_p50s if arm == "on" else off_p50s).append(
+                r["suggest_p50_exact_ms"]
+            )
+    p50_on, p50_off = min(on_p50s), min(off_p50s)
+    overhead = {
+        "p50_compile_plane_on_ms": p50_on,
+        "p50_compile_plane_off_ms": p50_off,
+        "p50_on_runs_ms": on_p50s,
+        "p50_off_runs_ms": off_p50s,
+        "p50_regression_frac": (
+            round(p50_on / p50_off - 1.0, 4) if p50_off else None
+        ),
+        "gate_frac": 0.05,
+    }
+
+    zero_cold = warm_stats["n_cold_after_ready"] == 0
+    sl607_clean = (
+        sl607_warm is not None and sl607_warm["breaches_total"] == 0
+        and sl607_warm["status"] != "breach"
+    )
+    ok = (
+        not errors
+        and coverage_frac is not None and coverage_frac >= 0.95
+        and zero_cold
+        and sl607_clean
+        # True required (None = no warm traffic, which the campaign
+        # sizes preclude — and the artifact guard asserts True too)
+        and ratio is not None and ratio < 0.85
+        and n_tagged == n_fallbacks
+        and warm_tail_cold["ok"] is True
+        and warm_tail_warm["ok"] is True
+        and (
+            overhead["p50_regression_frac"] is not None
+            and overhead["p50_regression_frac"] < 0.05
+        )
+    )
+    return {
+        "metric": "warmup_serve",
+        "ok": bool(ok),
+        "quick": bool(quick),
+        "errors": errors,
+        "platform": platform,
+        "n_studies": n_studies,
+        "phase1_trials_per_study": phase1_concurrent + 1,
+        "phase2_trials_per_study": phase2_trials,
+        "algo_params": ALGO_PARAMS,
+        "cold": {
+            "spawn_to_ready_s": round(cold_ready_s, 3),
+            "n_compile_events": sum(
+                cold_stats["compile_events"].values()
+            ),
+            "compile_grid": campaign_grid,
+            "ledger": cold_ledger,
+            "n_cold_fallbacks": cold_stats["n_cold_fallbacks"],
+            "warm_tail": warm_tail_cold,
+            "slo_breaching": status_cold["slo_breaching"],
+        },
+        "warmed": {
+            "spawn_to_ready_s": round(warmed_ready_s, 3),
+            "restart_gap_s": restart_dead_s,
+            "warmup": {
+                k: v for k, v in warmup_doc.items() if k != "items"
+            },
+            "warmup_items": warmup_doc["items"],
+            "n_cold_after_ready": warm_stats["n_cold_after_ready"],
+            "n_cold_suggests": warm_stats["n_cold_suggests"],
+            "n_cold_fallbacks": warm_stats["n_cold_fallbacks"],
+            "compile_events": warm_stats["compile_events"],
+            "cache_events": status_warm["compile_ledger"][
+                "cache_events"
+            ],
+            "warm_tail": warm_tail_warm,
+            "sl607": sl607_warm,
+            "ready_doc_warmup": ready_doc.get("warmup"),
+        },
+        "coverage": {
+            "campaign_grid": campaign_grid,
+            "warmed_before_ready": warmed_keys,
+            "covered": covered,
+            "frac": (
+                round(coverage_frac, 4)
+                if coverage_frac is not None else None
+            ),
+            "gate": 0.95,
+        },
+        "restart_ratio": {
+            "warmup_replay_s": warmup_replay_s,
+            "cold_compile_s": cold_compile_s,
+            "warmed_over_cold": ratio,
+            "gate": 0.85,
+        },
+        "served_cold": {
+            "n_fallbacks": n_fallbacks,
+            "n_trace_tagged": n_tagged,
+            "attributed": n_tagged == n_fallbacks,
+        },
+        "overhead": overhead,
+        "workdir": workdir,
+    }
+
+
+def write_report(report, out_path):
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--out", default=os.path.join(REPO, "WARMUP_SERVE.json")
+    )
+    options = ap.parse_args(argv)
+    report = run_report(quick=options.quick, seed=options.seed)
+    print(json.dumps(report, indent=1))
+    if options.out:
+        write_report(report, options.out)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

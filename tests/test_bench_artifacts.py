@@ -149,6 +149,89 @@ def test_trace_serve_artifact_attributes_the_tail():
         assert d["overhead"]["p50_regression_frac"] < 0.05
 
 
+WARMUP_SERVE = os.path.join(ROOT, "WARMUP_SERVE.json")
+
+
+@pytest.mark.skipif(
+    not os.path.exists(WARMUP_SERVE),
+    reason="no committed warmup artifact",
+)
+def test_warmup_serve_artifact_proves_the_closed_loop():
+    """The ISSUE-10 acceptance artifact: AOT warmup covers >=95% of
+    the campaign's bucket x family compile grid before /readyz, the
+    warmed restart serves ZERO request-path compiles after ready
+    (SL607 never breaches), the kill -9 restart's warmup replay is a
+    small fraction of the cold run's compile cost, every containment
+    fallback is trace-tagged served_cold, and the compile plane's
+    steady-state overhead is <5%.  Every guard is STRUCTURAL
+    (ratios/coverage/counts) — never absolute milliseconds: sandbox
+    latency swings ~30x between sessions, but one run's cold and
+    warmed measurements co-vary."""
+    d = _load(WARMUP_SERVE)
+    assert d["metric"] == "warmup_serve"
+    assert d["ok"] is True
+    # the committed artifact is the FULL capture (quick runs write
+    # WARMUP_SERVE.quick.json and must never clobber this one)
+    assert d["quick"] is False
+    assert d["errors"] == []
+    # warmup coverage of the campaign grid, before ready — and the
+    # fraction is internally consistent with the independent fields
+    cov = d["coverage"]
+    assert cov["frac"] >= 0.95
+    grid = set(cov["campaign_grid"])
+    assert grid, "empty campaign grid proves nothing"
+    assert cov["frac"] == pytest.approx(
+        len(grid & set(cov["warmed_before_ready"])) / len(grid), abs=1e-4
+    )
+    # zero-cold-compile serving after the warmed restart's /readyz
+    warmed = d["warmed"]
+    assert warmed["n_cold_after_ready"] == 0
+    assert warmed["sl607"]["breaches_total"] == 0
+    assert warmed["sl607"]["status"] != "breach"
+    assert warmed["warmup"]["finished"] is True
+    assert warmed["warmup"]["warmed"] == warmed["warmup"]["total"]
+    # the 503-body progress block the client logs from
+    assert "warmed" in warmed["ready_doc_warmup"]
+    # restart ratio: warmup replay work vs the cold compile bill —
+    # the persistent cache must make the restart a FRACTION, never a
+    # re-payment (ratio guard, no absolute seconds)
+    ratio = d["restart_ratio"]
+    assert ratio["warmed_over_cold"] is not None
+    assert ratio["warmed_over_cold"] < ratio["gate"] <= 0.85
+    assert ratio["cold_compile_s"] > 0
+    # every remaining cold request is attributed: containment
+    # fallbacks == served_cold-tagged traces (sampled at 1.0)
+    sc = d["served_cold"]
+    assert sc["attributed"] is True
+    assert sc["n_trace_tagged"] == sc["n_fallbacks"]
+    # the tail gate held on BOTH runs: warm p99 within the platform-
+    # calibrated multiple of warm p50 (ok is None only when a run had
+    # no warm traffic, which the campaign sizes preclude)
+    assert d["cold"]["warm_tail"]["ok"] is True
+    assert warmed["warm_tail"]["ok"] is True
+    # compile-plane-on steady state within 5% of the off baseline
+    assert d["overhead"]["p50_regression_frac"] < 0.05
+
+
+@needs_tpu_json
+@pytest.mark.skipif(
+    not os.path.exists(TPU_100K), reason="no committed 100k artifact"
+)
+def test_100k_warmup_restamp_carries_reason():
+    """The PR 10 re-stamp: the 50.7 s first-process warmup stays on
+    record, and the persistent-cache warmed-restart field is null WITH
+    a reason off-TPU (the PR 7 null contract), pointing at
+    WARMUP_SERVE.json for the measured CPU-backend ratio."""
+    d = _load(TPU_100K)
+    assert d["compile_warmup_s"] > 0
+    assert "compile_warmup_restart_s" in d
+    if d["compile_warmup_restart_s"] is None:
+        reason = d.get("compile_warmup_restart_reason")
+        assert reason and "TPU" in reason
+    else:
+        assert d["compile_warmup_restart_s"] < d["compile_warmup_s"]
+
+
 @needs_tpu_json
 @pytest.mark.skipif(
     not os.path.exists(TPU_100K), reason="no committed 100k artifact"
@@ -253,9 +336,12 @@ def test_slo_serve_artifact_guards_every_rule():
     # SLO_SERVE.quick.json and must never clobber this one)
     assert d["quick"] is False
     # healthy campaign: the full SL6xx catalog evaluated, nothing
-    # breaching (no_data only where the rule's own gate says so)
+    # breaching (no_data only where the rule's own gate says so).
+    # Superset, not equality: the catalog grows (SL607 cold-compile
+    # joined in PR 10) and an artifact captured before a rule existed
+    # stays valid
     rules = {r["rule"]: r for r in d["healthy"]["rules"]}
-    assert set(rules) == {
+    assert set(rules) >= {
         "SL601", "SL602", "SL603", "SL604", "SL605", "SL606"
     }
     for rule_id, r in rules.items():

@@ -1,0 +1,563 @@
+"""Compile-plane observability: the persistent compile ledger, the
+ledger-driven AOT warmup behind /readyz, and cold-request containment.
+
+- the ledger's on-disk format is crash-consistent: per-record CRC +
+  leading-newline resync (torn tail, real mid-write SIGKILL);
+- a ledger written under a different jax/library fingerprint must
+  never mark buckets warm (version invalidation);
+- a ledger record is self-sufficient for replay: zero-filled arguments
+  at the recorded shapes rebuild the EXACT fused program identity, so
+  a restarted server can pre-warm with no study state at all;
+- the warmup driver replays ledger + predicted (dry-prepare) grids off
+  the real dispatch path, /readyz gates on it, and its progress rides
+  the 503 body + GET /v1/warmup;
+- cold containment serves an unwarmed suggest host-side (tagged
+  served_cold) while the compile proceeds off-thread;
+- SL607 pages on post-ready cold-compile rate, never on warmup's own
+  compiles.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import compile_ledger, hp
+from hyperopt_tpu.algos import rand, tpe, tpe_device
+from hyperopt_tpu.base import JOB_STATE_DONE, STATUS_OK, Domain, Trials
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SPACE = {
+    "x": hp.uniform("x", -5, 5),
+    "c": hp.choice("c", ["a", "b"]),
+}
+
+ALGO_PARAMS = {"n_startup_jobs": 2, "n_EI_candidates": 16}
+
+
+def _history_trials(n=6, seed=0):
+    domain = Domain(lambda cfg: 0.0, SPACE)
+    trials = Trials()
+    rng = np.random.default_rng(seed)
+    for i in range(n):
+        (doc,) = rand.suggest([i], domain, trials, i)
+        doc["state"] = JOB_STATE_DONE
+        doc["result"] = {"status": STATUS_OK, "loss": float(rng.normal())}
+        trials._insert_trial_docs([doc])
+        trials.refresh()
+    return domain, trials
+
+
+def _prepared_requests(domain, trials, n_cand=16):
+    prep = tpe.suggest_prepare(
+        [999], domain, trials, 0, n_startup_jobs=2, n_EI_candidates=n_cand
+    )
+    assert prep is not None
+    return prep[0]
+
+
+# ---------------------------------------------------------------------
+# ledger format + crash consistency
+# ---------------------------------------------------------------------
+
+
+class TestLedgerFormat:
+    def _record_one(self, ledger, domain=None, trials=None, n_cand=16):
+        if domain is None:
+            domain, trials = _history_trials()
+        requests = _prepared_requests(domain, trials, n_cand=n_cand)
+        sig = tpe_device._multi_sig(requests)
+        shapes = tpe_device.args_shapes([a for _, a, _ in requests])
+        return ledger.record_compile(
+            sig, shapes, duration_s=1.25, cache_hit=False, n_requests=1
+        )
+
+    def test_roundtrip_latest_per_key(self, tmp_path):
+        path = str(tmp_path / "ledger.jsonl")
+        ledger = compile_ledger.CompileLedger(path)
+        domain, trials = _history_trials()
+        rec = self._record_one(ledger, domain, trials)
+        # same program again: latest record wins, no duplicate entry
+        ledger.record_compile(
+            rec["sig"], rec["shapes"], duration_s=0.5, cache_hit=True
+        )
+        assert len(ledger) == 1
+        # a DIFFERENT program (different candidate count -> statics)
+        self._record_one(ledger, domain, trials, n_cand=32)
+        assert len(ledger) == 2
+
+        loaded = compile_ledger.CompileLedger(path)
+        assert len(loaded) == 2
+        assert loaded.n_torn_lines == 0
+        by_key = {e["replay_key"]: e for e in loaded.entries()}
+        assert by_key[rec["replay_key"]]["duration_s"] == 0.5
+        assert by_key[rec["replay_key"]]["cache_hit"] is True
+        grid = loaded.grid()
+        assert all(isinstance(k[0], int) and k[1] for k in grid)
+
+    def test_torn_tail_resync(self, tmp_path):
+        path = str(tmp_path / "ledger.jsonl")
+        ledger = compile_ledger.CompileLedger(path)
+        domain, trials = _history_trials()
+        self._record_one(ledger, domain, trials, n_cand=16)
+        self._record_one(ledger, domain, trials, n_cand=32)
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) - 11)
+        loaded = compile_ledger.CompileLedger(path)
+        assert loaded.n_torn_lines == 1
+        assert len(loaded) == 1
+        # the next append's leading newline re-synchronizes the reader
+        self._record_one(loaded, domain, trials, n_cand=64)
+        again = compile_ledger.CompileLedger(path)
+        assert again.n_torn_lines == 1
+        assert len(again) == 2
+
+    def test_survives_midwrite_sigkill(self, tmp_path):
+        """A writer SIGKILL'd at a random moment leaves at most one
+        torn record; everything before it loads clean."""
+        path = str(tmp_path / "ledger.jsonl")
+        child = subprocess.Popen(
+            [sys.executable, "-c", f"""
+import sys; sys.path.insert(0, {REPO!r})
+from hyperopt_tpu import compile_ledger
+led = compile_ledger.CompileLedger({path!r})
+sig = [["cont", [["cap_b", 4], ["k", 1]]]]
+i = 0
+while True:
+    shapes = [[[[i % 7 + 1, 8], "float32"]]]
+    led.record_compile(sig, shapes, duration_s=0.1 * i)
+    i += 1
+"""],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if os.path.exists(path) and os.path.getsize(path) > 4096:
+                break
+            time.sleep(0.02)
+        child.send_signal(signal.SIGKILL)
+        child.wait(timeout=30)
+        assert os.path.getsize(path) > 0
+        loaded = compile_ledger.CompileLedger(path)
+        assert loaded.n_torn_lines <= 1
+        assert len(loaded) >= 1
+        # the survivors parse into well-formed records
+        for e in loaded.entries():
+            assert "replay_key" in e and "duration_s" in e
+
+    def test_compaction_keeps_live_entries(self, tmp_path):
+        path = str(tmp_path / "ledger.jsonl")
+        ledger = compile_ledger.CompileLedger(path)
+        sig = [["cont", [["cap_b", 4], ["k", 1]]]]
+        shapes = [[[[4, 8], "float32"]]]
+        for i in range(3 * compile_ledger.COMPACT_APPEND_FACTOR):
+            ledger.record_compile(sig, shapes, duration_s=float(i))
+        assert len(ledger) == 1
+        raw = open(path, "rb").read()
+        records, torn = __import__(
+            "hyperopt_tpu.tracing", fromlist=["parse_trace_log"]
+        ).parse_trace_log(raw)
+        assert torn == 0
+        # compacted at least once: far fewer lines than appends
+        assert len(records) < 2 * compile_ledger.COMPACT_APPEND_FACTOR
+        assert compile_ledger.CompileLedger(path).entries()[0][
+            "duration_s"
+        ] == float(3 * compile_ledger.COMPACT_APPEND_FACTOR - 1)
+
+    def test_fingerprint_invalidation(self, tmp_path):
+        """A stale ledger (older jax / different backend) must not mark
+        buckets warm: entries() filters, and the warmup driver skips."""
+        path = str(tmp_path / "ledger.jsonl")
+        ledger = compile_ledger.CompileLedger(path)
+        domain, trials = _history_trials()
+        requests = _prepared_requests(domain, trials)
+        sig = tpe_device._multi_sig(requests)
+        shapes = tpe_device.args_shapes([a for _, a, _ in requests])
+        stale_fp = {"version": "0.0.0", "jax": "0.0.1", "backend": "tpu"}
+        ledger.record_compile(sig, shapes, duration_s=9.0, fp=stale_fp)
+        current = compile_ledger.fingerprint()
+        assert ledger.entries() and not ledger.entries(
+            current_fingerprint=current
+        )
+        driver = compile_ledger.WarmupDriver(ledger=ledger)
+        assert driver.plan() == []
+        driver.start()
+        assert driver.wait(30)
+        assert driver.progress_brief()["total"] == 0
+        # the same record stamped with the CURRENT fingerprint replays
+        ledger2 = compile_ledger.CompileLedger(str(tmp_path / "l2.jsonl"))
+        ledger2.record_compile(sig, shapes, duration_s=9.0, fp=current)
+        driver2 = compile_ledger.WarmupDriver(ledger=ledger2)
+        plan = driver2.plan()
+        assert len(plan) == 1
+        assert plan[0]["source"] == "ledger"
+
+
+# ---------------------------------------------------------------------
+# replay identity + warm-key tracking
+# ---------------------------------------------------------------------
+
+
+class TestReplayIdentity:
+    def test_requests_from_record_not_replayable(self):
+        assert compile_ledger.requests_from_record({}) is None
+        assert compile_ledger.requests_from_record(
+            {"sig": [["cont", []]], "shapes": []}
+        ) is None
+        # a mesh-sharded program never replays from JSON
+        rec = {
+            "sig": [["cont", [["mesh", "Mesh(dp=4)"], ["k", 1]]]],
+            "shapes": [[[[4, 8], "float32"]]],
+        }
+        assert compile_ledger.requests_from_record(rec) is None
+
+    def test_replay_reproduces_program_identity(self, tmp_path):
+        """Zero-filled args at the recorded shapes map to the same
+        program key the live dispatch traced — and dispatching the
+        replay of a warm program never retraces."""
+        path = str(tmp_path / "ledger.jsonl")
+        ledger = compile_ledger.CompileLedger(path)
+        recorder = compile_ledger.CompileLedgerRecorder(ledger).install()
+        try:
+            domain, trials = _history_trials()
+            requests = _prepared_requests(domain, trials)
+            assert not tpe_device.is_warm(requests)
+            tpe_device.multi_family_suggest_async(requests)()
+            assert tpe_device.is_warm(requests)
+            assert len(ledger) == 1
+            (rec,) = compile_ledger.CompileLedger(path).entries()
+            replay = compile_ledger.requests_from_record(rec)
+            assert replay is not None
+            assert tpe_device.is_warm(replay)
+            n_before = len(ledger)
+            tpe_device.multi_family_suggest_async(replay)()
+            assert len(ledger) == n_before, "replay of warm program retraced"
+            # the recorder stamped the shared attribution key
+            assert (rec["bucket"], rec["families"]) in [
+                (int(b), f) for (b, f) in ledger.grid()
+            ]
+            assert rec["duration_s"] > 0
+        finally:
+            recorder.uninstall()
+
+    def test_reset_device_state_clears_warm_keys(self):
+        domain, trials = _history_trials(seed=3)
+        requests = _prepared_requests(domain, trials, n_cand=24)
+        tpe_device.multi_family_suggest_async(requests)()
+        assert tpe_device.is_warm(requests)
+        tpe_device.reset_device_state()
+        assert not tpe_device.is_warm(requests)
+
+    def test_fused_is_warm_canonical_order(self):
+        d1, t1 = _history_trials(seed=11)
+        d2, t2 = _history_trials(n=10, seed=12)
+        g1 = _prepared_requests(d1, t1, n_cand=48)
+        g2 = _prepared_requests(d2, t2, n_cand=48)
+        if tpe_device.fused_is_warm([g1, g2]):
+            tpe_device.reset_device_state()
+            g1 = _prepared_requests(d1, t1, n_cand=48)
+            g2 = _prepared_requests(d2, t2, n_cand=48)
+        assert not tpe_device.fused_is_warm([g1, g2])
+        tpe_device.multi_study_suggest_async([g1, g2])[0]()
+        # batch order must not matter — the fused key is canonical
+        assert tpe_device.fused_is_warm([g1, g2])
+        assert tpe_device.fused_is_warm([g2, g1])
+
+
+# ---------------------------------------------------------------------
+# warmup behind /readyz (service level)
+# ---------------------------------------------------------------------
+
+
+def _service(root, **kwargs):
+    from hyperopt_tpu.service import OptimizationService
+
+    kwargs.setdefault("slo_tick", 3600)
+    return OptimizationService(root=str(root), **kwargs)
+
+
+def _drive(svc, sid="s1", n=5, seed=0):
+    svc.create_study(
+        sid, SPACE, seed=seed, algo="tpe", algo_params=ALGO_PARAMS,
+        exist_ok=True,
+    )
+    for _ in range(n):
+        (t,) = svc.suggest(sid)
+        svc.report(sid, t["tid"], loss=float(t["vals"]["x"]) ** 2)
+
+
+class TestWarmupService:
+    def test_restart_warms_from_ledger_and_prediction(self, tmp_path):
+        svc = _service(tmp_path)
+        try:
+            _drive(svc, n=5)
+            assert len(svc.compile_ledger) >= 1
+        finally:
+            svc.close()
+        # the ledger survived on disk next to the studies
+        assert os.path.exists(
+            os.path.join(str(tmp_path), "compile_ledger.jsonl")
+        )
+        svc2 = _service(tmp_path)
+        try:
+            assert svc2.warmup.wait(120)
+            status = svc2.warmup_status()
+            assert status["finished"] is True
+            assert status["total"] >= 1
+            states = {i["state"] for i in status["items"]}
+            assert states <= {"warm", "skipped"}
+            assert any(i["source"] == "ledger" for i in status["items"])
+            r = svc2.readiness()
+            assert r["ready"] is True
+            assert r["warmup"]["finished"] is True
+            assert r["warmup"]["warmed"] == r["warmup"]["total"]
+            # post-warmup, the recovered study's next suggest is warm:
+            # zero cold suggests after ready
+            (t,) = svc2.suggest("s1")
+            assert t["tid"] is not None
+            stats = svc2.stats.summary()
+            assert stats["n_cold_after_ready"] == 0
+        finally:
+            svc2.close()
+
+    def test_prediction_probe_without_ledger(self, tmp_path):
+        """With no ledger at all, the dry-prepare probe per recovered
+        study still predicts the grid (the RecompilationAuditor
+        inventory path)."""
+        tpe_device.reset_device_state()  # force a real compile below
+        svc = _service(tmp_path)
+        try:
+            _drive(svc, n=5)
+        finally:
+            svc.close()
+        ledger_path = os.path.join(str(tmp_path), "compile_ledger.jsonl")
+        if os.path.exists(ledger_path):
+            os.unlink(ledger_path)
+        tpe_device.reset_device_state()
+        svc2 = _service(tmp_path)
+        try:
+            assert svc2.warmup.wait(120)
+            status = svc2.warmup_status()
+            assert status["total"] >= 1
+            assert any(
+                i["source"] == "predicted" for i in status["items"]
+            )
+            assert all(i["state"] == "warm" for i in status["items"])
+            # the probe consumed nothing: seed cursor untouched
+            study = svc2.registry.get("s1")
+            assert study.n_seeds_drawn == study.n_seeds_committed
+        finally:
+            svc2.close()
+
+    def test_readyz_503_body_reports_warmup_progress(self, tmp_path):
+        svc = _service(tmp_path)
+        try:
+            # hold warmup open artificially: readiness must say 503
+            # WITH warmup progress (the wait_ready-actionable body)
+            svc.warmup._done.clear()
+            r = svc.readiness()
+            assert r["ready"] is False
+            assert r["warmup"]["finished"] is False
+            assert "warmed" in r["warmup"] and "total" in r["warmup"]
+            svc.warmup._done.set()
+            assert svc.readiness()["ready"] is True
+        finally:
+            svc.close()
+
+    def test_warmup_over_http_and_client(self, tmp_path):
+        from hyperopt_tpu.service import ServiceClient, ServiceServer
+
+        svc = _service(tmp_path)
+        server = ServiceServer(svc).start()
+        try:
+            client = ServiceClient(server.url)
+            ready = client.wait_ready(timeout=60)
+            assert ready["warmup"]["finished"] is True
+            wu = client.warmup()
+            assert wu["finished"] is True
+            assert isinstance(wu["items"], list)
+            assert wu["ledger"] is not None
+            text = client.metrics()
+            assert "hyperopt_compile_warmup_total" in text
+            assert "hyperopt_compile_warmup_finished 1" in text
+            assert "hyperopt_compile_cache_hits_total" in text
+        finally:
+            server.stop()
+
+    def test_warmup_disabled(self, tmp_path):
+        svc = _service(tmp_path, warmup=False)
+        try:
+            assert svc.warmup.finished
+            assert svc.readiness()["ready"] is True
+            assert svc.readiness()["warmup"]["enabled"] is False
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------
+# cold containment
+# ---------------------------------------------------------------------
+
+
+class TestColdContainment:
+    def test_cold_fallback_serves_host_side_then_warms(self, tmp_path):
+        tpe_device.reset_device_state()
+        svc = _service(tmp_path, cold_fallback=True)
+        try:
+            svc.create_study(
+                "cold", SPACE, seed=0, algo="tpe",
+                algo_params=ALGO_PARAMS,
+            )
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                (t,) = svc.suggest("cold")
+                svc.report(
+                    "cold", t["tid"], loss=float(t["vals"]["x"]) ** 2
+                )
+                stats = svc.stats.summary()
+                if stats["n_dispatches"] >= 1:
+                    break
+                # give the background compile thread a beat
+                time.sleep(0.05)
+            stats = svc.stats.summary()
+            # the first device-plane suggest hit an unwarmed program:
+            # served from the host-side fallback, compile off-thread
+            assert stats["n_cold_fallbacks"] >= 1
+            # the background compile landed and later suggests went
+            # through the device plane (fused dispatches happened)
+            assert stats["n_dispatches"] >= 1
+            # containment kept compiles out of the request path
+            # entirely: background compiles are excluded from cold
+            # attribution (tpe_device.background_compiles), so no
+            # request is ever tagged cold — not even one overlapping
+            # an off-thread compile event
+            assert stats["n_cold_suggests"] == 0
+            assert stats["phase_seconds"].get("cold_fallback")
+        finally:
+            svc.close()
+
+    def test_cold_fallback_off_keeps_exact_trajectory(self, tmp_path):
+        """Default (containment off): the served trajectory equals the
+        serial fmin trajectory — the determinism contract is intact."""
+        from hyperopt_tpu.fmin import fmin
+
+        svc = _service(tmp_path / "svc", cold_fallback=False)
+        try:
+            svc.create_study(
+                "det", SPACE, seed=7, algo="tpe", algo_params=ALGO_PARAMS
+            )
+            got = []
+            for _ in range(6):
+                (t,) = svc.suggest("det")
+                svc.report(
+                    "det", t["tid"], loss=float(t["vals"]["x"]) ** 2
+                )
+                got.append(t["vals"]["x"])
+        finally:
+            svc.close()
+        trials = Trials()
+        fmin(
+            lambda cfg: cfg["x"] ** 2, SPACE,
+            algo=__import__(
+                "functools"
+            ).partial(tpe.suggest, **ALGO_PARAMS),
+            max_evals=6, trials=trials, rstate=np.random.default_rng(7),
+        )
+        ref = [v[0] for v in (
+            d["misc"]["vals"]["x"] for d in trials._dynamic_trials
+        )]
+        np.testing.assert_allclose(got, ref)
+
+
+# ---------------------------------------------------------------------
+# SL607
+# ---------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestSL607:
+    def _engine(self, ss, clock):
+        from hyperopt_tpu import slo
+
+        return slo.SloEngine(
+            service_stats=ss, time_fn=clock, min_window_s=0.0,
+            snapshot_interval=1.0,
+        )
+
+    def test_cold_before_ready_never_counts(self):
+        from hyperopt_tpu.observability import ServiceStats
+
+        ss = ServiceStats()
+        clock = _Clock()
+        eng = self._engine(ss, clock)
+        for _ in range(40):
+            ss.record_request("suggest", seconds=0.01, cold=True)
+        clock.t = 100.0
+        rows = {r["rule"]: r for r in eng.evaluate(force=True)}
+        assert rows["SL607"]["status"] == "ok"
+        assert rows["SL607"]["value"] == 0.0
+
+    def test_post_ready_cold_rate_breaches(self):
+        from hyperopt_tpu.observability import ServiceStats
+
+        ss = ServiceStats()
+        clock = _Clock()
+        eng = self._engine(ss, clock)
+        ss.mark_ready()
+        for i in range(40):
+            ss.record_request("suggest", seconds=0.01, cold=(i % 2 == 0))
+        clock.t = 100.0
+        rows = {r["rule"]: r for r in eng.evaluate(force=True)}
+        assert rows["SL607"]["status"] == "breach"
+        assert rows["SL607"]["value"] == pytest.approx(0.5)
+
+    def test_quiet_window_with_cold_suggest_still_counts(self):
+        from hyperopt_tpu.observability import ServiceStats
+
+        ss = ServiceStats()
+        clock = _Clock()
+        eng = self._engine(ss, clock)
+        ss.mark_ready()
+        for _ in range(3):
+            ss.record_request("suggest", seconds=0.01, cold=True)
+        clock.t = 100.0
+        rows = {r["rule"]: r for r in eng.evaluate(force=True)}
+        # 3 cold / floor-of-20 = 15% >> 1% budget: a compile storm in a
+        # quiet window must not hide behind the traffic floor
+        assert rows["SL607"]["status"] == "breach"
+
+    def test_no_traffic_is_no_data(self):
+        from hyperopt_tpu.observability import ServiceStats
+
+        ss = ServiceStats()
+        clock = _Clock()
+        eng = self._engine(ss, clock)
+        clock.t = 100.0
+        rows = {r["rule"]: r for r in eng.evaluate(force=True)}
+        assert rows["SL607"]["status"] == "no_data"
+
+
+# ---------------------------------------------------------------------
+# race-lint registration
+# ---------------------------------------------------------------------
+
+
+def test_compile_ledger_registered_for_race_lint():
+    from hyperopt_tpu.analysis import RACE_LINT_FILES, lint_file
+
+    path = os.path.join(REPO, "hyperopt_tpu", "compile_ledger.py")
+    assert path in RACE_LINT_FILES
+    assert lint_file(path) == []

@@ -154,11 +154,15 @@ def test_pallas_fma_env_default(monkeypatch):
     from hyperopt_tpu.ops import pallas_gmm
 
     monkeypatch.delenv("HYPEROPT_TPU_PALLAS_FMA", raising=False)
-    assert pallas_gmm._default_fma() is False
+    assert pallas_gmm.resolve_fma("batched") is False
+    monkeypatch.setenv("HYPEROPT_TPU_PALLAS_FMA", "1")
+    assert pallas_gmm.resolve_fma("batched") is True
+    monkeypatch.setenv("HYPEROPT_TPU_PALLAS_FMA", "0")
+    assert pallas_gmm.resolve_fma("batched") is False
+    # the back-compat alias routes through the same resolver
     monkeypatch.setenv("HYPEROPT_TPU_PALLAS_FMA", "1")
     assert pallas_gmm._default_fma() is True
-    monkeypatch.setenv("HYPEROPT_TPU_PALLAS_FMA", "0")
-    assert pallas_gmm._default_fma() is False
+    assert pallas_gmm._default_fma(batched=False) is True
 
 
 def test_fma_measured_default_precedence(monkeypatch):
@@ -169,19 +173,68 @@ def test_fma_measured_default_precedence(monkeypatch):
     # rolled back at teardown (kernel="both" touches the unbatched one)
     monkeypatch.setattr(pallas_gmm, "_fma_measured_default", None)
     monkeypatch.setattr(pallas_gmm, "_fma_measured_default_unbatched", None)
-    assert pallas_gmm._default_fma() is False
-    assert pallas_gmm._default_fma(batched=False) is False
+    assert pallas_gmm.resolve_fma("batched") is False
+    assert pallas_gmm.resolve_fma("unbatched") is False
     pallas_gmm.set_default_fma(True)
-    assert pallas_gmm._default_fma() is True
-    assert pallas_gmm._default_fma(batched=False) is True
-    # per-kernel defaults are independent
+    assert pallas_gmm.resolve_fma("batched") is True
+    assert pallas_gmm.resolve_fma("unbatched") is True
+    # per-kernel measurements that DISAGREE are both honored (their
+    # grids/VMEM residency legitimately differ)
     pallas_gmm.set_default_fma(False, kernel="unbatched")
-    assert pallas_gmm._default_fma() is True
-    assert pallas_gmm._default_fma(batched=False) is False
+    assert pallas_gmm.resolve_fma("batched") is True
+    assert pallas_gmm.resolve_fma("unbatched") is False
     # env override beats the measured default
     monkeypatch.setenv("HYPEROPT_TPU_PALLAS_FMA", "0")
-    assert pallas_gmm._default_fma() is False
-    assert pallas_gmm._default_fma(batched=False) is False
+    assert pallas_gmm.resolve_fma("batched") is False
+    assert pallas_gmm.resolve_fma("unbatched") is False
+
+
+def test_fma_single_probe_applies_to_both_kernels(monkeypatch):
+    """The ROADMAP's pallas_fma_default inconsistency: a probe (or
+    set_default_fma call) that measured only ONE kernel must set the
+    default for BOTH scorer paths — never measured-FMA on one path and
+    silent-MXU on the other."""
+    from hyperopt_tpu.ops import pallas_gmm
+
+    monkeypatch.delenv("HYPEROPT_TPU_PALLAS_FMA", raising=False)
+    monkeypatch.setattr(pallas_gmm, "_fma_measured_default", None)
+    monkeypatch.setattr(pallas_gmm, "_fma_measured_default_unbatched", None)
+    pallas_gmm.set_default_fma(True, kernel="batched")
+    assert pallas_gmm.resolve_fma("batched") is True
+    assert pallas_gmm.resolve_fma("unbatched") is True
+    monkeypatch.setattr(pallas_gmm, "_fma_measured_default", None)
+    pallas_gmm.set_default_fma(True, kernel="unbatched")
+    assert pallas_gmm.resolve_fma("batched") is True
+    assert pallas_gmm.resolve_fma("unbatched") is True
+    with pytest.raises(ValueError):
+        pallas_gmm.resolve_fma("nonesuch")
+
+
+def test_fma_entry_points_share_the_resolver(monkeypatch):
+    """Both public scorer entry points resolve fma=None through
+    resolve_fma with their own kernel name — the 'one resolver'
+    contract itself."""
+    from hyperopt_tpu.ops import pallas_gmm
+
+    seen = []
+    real = pallas_gmm.resolve_fma
+
+    def spy(kernel="batched"):
+        seen.append(kernel)
+        return real(kernel)
+
+    monkeypatch.setattr(pallas_gmm, "resolve_fma", spy)
+    below, above = make_pair(K=20, padded_tail=2)
+    z = np.random.default_rng(8).uniform(-4, 4, 32).astype(np.float32)
+    P = pair_params(*below, *above)
+    pallas_gmm.pair_score_pallas(z, P, 20, tc=32, tk=128, interpret=True)
+    from hyperopt_tpu.ops.pallas_gmm import pair_score_pallas_batched
+
+    pair_score_pallas_batched(
+        np.stack([z, z]), np.stack([np.asarray(P)] * 2), 20,
+        tc=32, tk=128, interpret=True,
+    )
+    assert seen == ["unbatched", "batched"]
 
 
 def test_fma_probe_not_run_off_tpu(monkeypatch):

@@ -293,7 +293,8 @@ class TestMultiWindow:
         env = _engine(clock)
         rows = env["engine"].evaluate(force=True)
         assert {r["rule"] for r in rows} == {
-            "SL601", "SL602", "SL603", "SL604", "SL605", "SL606"
+            "SL601", "SL602", "SL603", "SL604", "SL605", "SL606",
+            "SL607",
         }
         for r in rows:
             assert r["status"] in ("ok", "breach", "no_data")
@@ -509,7 +510,8 @@ class TestServiceIntegration:
             self._drive(svc, n=2)
             al = svc.alerts()
             assert {r["rule"] for r in al["rules"]} == {
-                "SL601", "SL602", "SL603", "SL604", "SL605", "SL606"
+                "SL601", "SL602", "SL603", "SL604", "SL605", "SL606",
+                "SL607",
             }
             assert al["breaching"] == [
                 r["rule"] for r in al["rules"] if not r["ok"]
@@ -537,7 +539,7 @@ class TestServiceIntegration:
         try:
             client = ServiceClient(server.url)
             al = client.alerts()
-            assert len(al["rules"]) == 6
+            assert len(al["rules"]) == 7
             st = client.service_status()
             assert "version" in st and "started_at" in st
             assert st["version"]["version"]
