@@ -833,6 +833,80 @@ def warmup_section(argv):
     return 0 if report["ok"] else 1
 
 
+def sharded_section(argv):
+    """``python bench.py --sharded [--quick]``: mesh execution mode.
+
+    ``--quick`` is the CI smoke: a forced 8-device virtual CPU mesh,
+    small k grid, writes ``BENCH_TPU_sharded.quick.json`` — every mesh
+    code path (sharded pair scoring, replicated history placement,
+    per-device limiter attribution) executes in tier-1 without a TPU.
+    WITHOUT ``--quick`` this is the real capture: the full sweep on the
+    LIVE backend (run it on the multi-chip TPU host), writing
+    ``BENCH_TPU_sharded.json`` with the same ``ok``/coverage fields the
+    artifact guard asserts.  Prints ONE JSON line like the other bench
+    sections."""
+    quick = "--quick" in argv
+    if quick:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    sweep = _import_script("batched_suggest_sweep")
+    # a quick smoke must not clobber the committed full-run artifact
+    out_path = (
+        "BENCH_TPU_sharded.quick.json" if quick else "BENCH_TPU_sharded.json"
+    )
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    t0 = time.time()
+    if quick:
+        report = sweep.run_sweep(
+            ks=(8, 32), reps=2, mesh_arms=(None, "auto"),
+            n_history=2_000, n_cand=512,
+        )
+    else:
+        report = sweep.run_sweep(mesh_arms=(None, "auto"))
+    report["quick"] = quick
+    import jax
+
+    n_devices = int(jax.device_count())
+    mesh_rows = [r for r in report["rows"] if r["mesh"] != "off"]
+    off_rows = [r for r in report["rows"] if r["mesh"] == "off"]
+    ok = (
+        bool(mesh_rows) and bool(off_rows)
+        and all(r["suggests_per_sec"] > 0 for r in report["rows"])
+        # the mesh arm's dispatches really spanned every local chip
+        and all(len(r["per_device"]) == n_devices for r in mesh_rows)
+        and all(
+            row["n_dispatches"] > 0 for r in mesh_rows
+            for row in r["per_device"].values()
+        )
+    )
+    report["ok"] = ok
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    best_mesh = max(
+        (r["suggests_per_sec"] for r in mesh_rows), default=0.0
+    )
+    out = {
+        "metric": "sharded_suggest_smoke",
+        "value": best_mesh,
+        "unit": "suggests/s",
+        "ok": ok,
+        "platform": report["platform"],
+        "n_devices": n_devices,
+        "mesh_arms": report["mesh_arms"],
+        "rows": len(report["rows"]),
+        "artifact": out_path,
+        "elapsed_s": round(time.time() - t0, 2),
+    }
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
 def device_profile_section(argv):
     """``python bench.py --device-profile [--quick]``: device-plane
     observability smoke — runs the roofline-profiled suggest workload
@@ -889,6 +963,9 @@ def main():
     if "--device-profile" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--device-profile"]
         return device_profile_section(argv)
+    if "--sharded" in sys.argv[1:]:
+        argv = [a for a in sys.argv[1:] if a != "--sharded"]
+        return sharded_section(argv)
     if "--wallclock" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--wallclock"]
         return wallclock_section(argv)

@@ -847,6 +847,14 @@ def _suggest_impl(
     n_EI_candidates, gamma, linear_forgetting, param_locks, trial_filter,
     mesh, defer, pending=None, prepare=False,
 ):
+    if mesh is not None:
+        # normalize the production forms — a DeviceMesh or a spec
+        # string ("auto"/"off"/"DPxSP") — to the jax Mesh the device
+        # plane shards over; a degenerate (one-device/off) mesh becomes
+        # None, i.e. bit-for-bit the single-chip program
+        from ..parallel.sharding import resolve_mesh
+
+        mesh = resolve_mesh(mesh)
     hist = trials.history
     # Startup gate on ALL inserted non-error trials (reference semantics:
     # ``len(trials.trials)``), not completed-OK count — with async backends

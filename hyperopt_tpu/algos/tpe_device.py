@@ -761,6 +761,16 @@ def _family_suggest_core(
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
+            # pin the draw's output replicated FIRST: the candidate
+            # sharding below must not back-propagate into the fit/draw
+            # stages (see _sharded_pair_apply — the upstream program
+            # must stay the single-chip program), THEN lay the
+            # candidate axis across dp.  Per-candidate lpdf has no
+            # cross-candidate reduction, so the dp split cannot change
+            # a single value.
+            cands = jax.lax.with_sharding_constraint(
+                cands, NamedSharding(mesh, PartitionSpec())
+            )
             cands = jax.lax.with_sharding_constraint(
                 cands, NamedSharding(mesh, PartitionSpec(None, "dp"))
             )
@@ -771,6 +781,15 @@ def _family_suggest_core(
             ) - gmm_ops.gmm_lpdf(cand, wa, ma, sa, lo, hi, qq, log_scale, quantized)
 
         score = jax.vmap(score_one)(cands, *B, *A, lo, hi, qq)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            # end of the dp-sharded region (same containment as
+            # _sharded_pair_apply): the argmax downstream compiles
+            # replicated, i.e. as the single-chip program
+            score = jax.lax.with_sharding_constraint(
+                score, NamedSharding(mesh, PartitionSpec())
+            )
     else:
         z = jnp.log(jnp.maximum(cands, EPS)) if log_scale else cands
         params = jax.vmap(pair_params)(*B, *A)  # [L, 3, Kb+Ka]
@@ -805,8 +824,21 @@ def _family_suggest_core(
 def _sharded_pair_apply(mesh, z, params, k_below):
     """Pad (C → |dp|-multiple, K → |sp|-multiple with NEG_BIG logit
     columns, which contribute exactly zero mass) and run the sharded
-    batched pair scorer; slice back to the real candidate count."""
+    batched pair scorer; slice back to the real candidate count.
+
+    The operands are pinned REPLICATED at the shard_map boundary.  This
+    is both the determinism contract and a miscompile guard: without
+    the pins, XLA's SPMD partitioner back-propagates the shard_map's
+    in_specs into the upstream fit/sample program — the γ-split
+    argsorts and ``pair_params``' unequal-size concatenate along the
+    to-be-sharded component axis — which this jax/XLA build partitions
+    INCORRECTLY (observed: params off by ~1e30 in padding columns,
+    scores off by ~5 absolute, a different EI winner).  Pinned, the
+    upstream compiles as the exact single-chip program (same values
+    bit-for-bit), and the mesh pays one slice per device at entry —
+    O(history) bytes, trivial next to the O(C·K) scoring it buys."""
     import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
 
     from ..ops.score import NEG_BIG
     from ..parallel.sharding import make_sharded_pair_score_batched
@@ -822,8 +854,14 @@ def _sharded_pair_apply(mesh, z, params, k_below):
     if k_pad:
         pad_cols = jnp.zeros((L, 3, k_pad), params.dtype).at[:, 2, :].set(NEG_BIG)
         params = jnp.concatenate([params, pad_cols], axis=2)
+    rep = NamedSharding(mesh, PartitionSpec())
+    z = jax.lax.with_sharding_constraint(z, rep)
+    params = jax.lax.with_sharding_constraint(params, rep)
     s = make_sharded_pair_score_batched(mesh)(z, params, jnp.int32(k_below))
-    return s[:, :C]
+    # pin the scores back to replicated before the argmax: the sharded
+    # region ends HERE, downstream must compile as the single-chip
+    # program (same partitioner-bug containment as the input pins)
+    return jax.lax.with_sharding_constraint(s[:, :C], rep)
 
 
 def _index_family_suggest_core(
@@ -1064,6 +1102,24 @@ def _build_multi_run(requests):
         )
         for kind, _, st in requests
     ]
+    # the one mesh of the fused program (all cont families of one
+    # suggest share it; batched studies share the service's).  Mesh-less
+    # families fusing WITH a mesh is fine — their entry pin below just
+    # compiles them replicated — but two DIFFERENT meshes in one
+    # program cannot both anchor the replicated-pin containment, so
+    # refuse loudly instead of miscompiling (the service rejects such
+    # studies at create; this backstops direct library callers).
+    fused_meshes = []
+    for _, _, st in requests:
+        m = st.get("mesh")
+        if m is not None and m not in fused_meshes:
+            fused_meshes.append(m)
+    if len(fused_meshes) > 1:
+        raise ValueError(
+            f"cannot fuse requests with {len(fused_meshes)} different "
+            f"device meshes into one program; batch per-mesh instead"
+        )
+    fused_mesh = fused_meshes[0] if fused_meshes else None
 
     def run(args_list):
         # the body of a jitted callable executes only while XLA traces
@@ -1073,6 +1129,26 @@ def _build_multi_run(requests):
             shapes = args_shapes(args_list)
             for obs in list(_trace_observers):
                 obs(sig, shapes)
+        if fused_mesh is not None:
+            # pin EVERY family's inputs replicated at program entry.
+            # The shard_map / dp regions deep inside the cont cores
+            # would otherwise let XLA's SPMD partitioner propagate
+            # shardings across the WHOLE fused program — including
+            # batch-mates' index families and the shared argsorts,
+            # which this jax/XLA build partitions incorrectly (see
+            # _sharded_pair_apply).  Pinned, everything outside the
+            # explicitly sharded scoring compiles as the single-chip
+            # program — which is also the determinism contract.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(fused_mesh, PartitionSpec())
+            args_list = [
+                tuple(
+                    jax.lax.with_sharding_constraint(a, rep)
+                    for a in args
+                )
+                for args in args_list
+            ]
         outs = [core(*a) for core, a in zip(cores, args_list)]
         # per family: winners then the [L, DIAG_COLS] search-health row
         # (see hyperopt_tpu.diagnostics) — one flat f32 output either way

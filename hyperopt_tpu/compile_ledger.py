@@ -65,10 +65,14 @@ COMPACT_APPEND_FACTOR = 8
 
 
 def fingerprint() -> dict:
-    """The ledger's validity scope: jax + library version and backend.
-    A record written under a different fingerprint must never mark a
-    bucket warm — an older jax's executables (and jit cache keys) are
-    not this process's."""
+    """The ledger's validity scope: jax + library version, backend, and
+    the DEVICE TOPOLOGY this process serves on.  A record written under
+    a different fingerprint must never mark a bucket warm — an older
+    jax's executables (and jit cache keys) are not this process's, and
+    a single-chip ledger entry replayed onto a mesh (or vice versa)
+    would warm the WRONG program grid: the mesh is part of the jit
+    statics, so the sharded and unsharded programs are different
+    executables end to end."""
     import jax
 
     try:
@@ -79,6 +83,40 @@ def fingerprint() -> dict:
         "version": str(version),
         "jax": str(jax.__version__),
         "backend": str(jax.default_backend()),
+        "topology": current_topology(),
+    }
+
+
+# The serving topology this process compiles under: backend + local
+# device count + mesh shape ("off" when the service dispatches
+# single-chip).  Stamped into every fingerprint; the service sets it
+# once at startup from its resolved --mesh flag.
+_topology_lock = threading.Lock()
+_topology_mesh = "off"  # guarded-by: _topology_lock
+
+
+def set_topology(mesh) -> dict:
+    """Register the serving mesh (any form :func:`~hyperopt_tpu
+    .parallel.sharding.mesh_shape_str` accepts) in the process
+    fingerprint; returns the resulting topology dict."""
+    from .parallel.sharding import mesh_shape_str
+
+    global _topology_mesh
+    shape = mesh_shape_str(mesh)
+    with _topology_lock:
+        _topology_mesh = shape
+    return current_topology()
+
+
+def current_topology() -> dict:
+    import jax
+
+    with _topology_lock:
+        mesh = _topology_mesh
+    return {
+        "backend": str(jax.default_backend()),
+        "device_count": int(jax.device_count()),
+        "mesh": mesh,
     }
 
 
@@ -156,12 +194,36 @@ def enable_persistent_cache(cache_dir) -> bool:
 # ---------------------------------------------------------------------
 
 
+MESH_TOKEN = "__mesh__"
+
+
+def _jsonable_default(obj):
+    """JSON fallback for non-scalar statics: a live Mesh serializes as
+    its shape token (``{"__mesh__": "DPxSP"}``) — replay substitutes
+    the process's CURRENT mesh when (and only when) the shape matches,
+    which the topology fingerprint already guarantees for records that
+    reach warmup at all."""
+    try:
+        from jax.sharding import Mesh
+
+        if isinstance(obj, Mesh):
+            from .parallel.sharding import mesh_shape_str
+
+            return {MESH_TOKEN: mesh_shape_str(obj)}
+    except Exception:  # pragma: no cover - defensive
+        pass
+    raise TypeError(
+        f"unserializable static {type(obj).__name__!r} in compile record"
+    )
+
+
 def sig_shapes_jsonable(sig, shapes):
     """The JSON form of one ``(sig, shapes)`` trace-observer pair.
-    Tuples become lists; every leaf is a scalar — the round trip back
-    through :func:`requests_from_record` rebuilds value-equal statics,
-    and zero arrays at the recorded shapes rebuild the jit cache key."""
-    return json.loads(json.dumps([sig, shapes]))
+    Tuples become lists; every leaf is a scalar (a Mesh static becomes
+    its shape token) — the round trip back through
+    :func:`requests_from_record` rebuilds value-equal statics, and zero
+    arrays at the recorded shapes rebuild the jit cache key."""
+    return json.loads(json.dumps([sig, shapes], default=_jsonable_default))
 
 
 def _key_from_jsonable(jsonable) -> str:
@@ -174,23 +236,44 @@ def replay_key(sig, shapes) -> str:
     return _key_from_jsonable(sig_shapes_jsonable(sig, shapes))
 
 
-def requests_from_record(rec):
+def requests_from_record(rec, mesh=None):
     """Rebuild the ``(kind, args, statics)`` request list of a ledger
     record — zero-filled arguments at the recorded shapes/dtypes, which
     reproduce the exact jit cache key the original dispatch traced.
-    Returns None when the record is not replayable (no sig/shapes, or a
-    mesh-sharded program whose Mesh cannot be serialized)."""
+
+    ``mesh``: the process's live serving mesh (any form
+    ``sharding.resolve_mesh`` accepts).  A record whose program was
+    mesh-sharded carries the shape token; replay substitutes the live
+    mesh when the shapes match — topology-aware warmup warms the
+    SHARDED program grid.  Returns None when the record is not
+    replayable (no sig/shapes, or a mesh token this process's topology
+    cannot satisfy)."""
     import numpy as np
 
     sig = rec.get("sig")
     shapes = rec.get("shapes")
     if not sig or not shapes or len(sig) != len(shapes):
         return None
+    live_mesh = None
+    if mesh is not None:
+        from .parallel.sharding import resolve_mesh
+
+        live_mesh = resolve_mesh(mesh)
     requests = []
     for (kind, st_items), fam_shapes in zip(sig, shapes):
         statics = {str(k): _static_value(v) for k, v in st_items}
-        if statics.get("mesh") is not None:
-            return None  # a live Mesh never round-trips through JSON
+        rec_mesh = statics.get("mesh")
+        if isinstance(rec_mesh, dict) and MESH_TOKEN in rec_mesh:
+            from .parallel.sharding import mesh_shape_str
+
+            if (
+                live_mesh is None
+                or mesh_shape_str(live_mesh) != rec_mesh[MESH_TOKEN]
+            ):
+                return None  # sharded program, topology unavailable
+            statics["mesh"] = live_mesh
+        elif rec_mesh is not None:
+            return None  # unrecognized mesh encoding (older record)
         try:
             # a TUPLE, exactly like suggest_prepare builds: the args
             # container is part of the jit pytree structure — a list
@@ -532,11 +615,15 @@ class WarmupDriver:
 
     # lock-order: _lock  (never held across a dispatch or a study lock)
     def __init__(self, ledger: CompileLedger = None, studies=(),
-                 device_recovery=None, enabled=True):
+                 device_recovery=None, enabled=True, mesh=None):
         self.ledger = ledger
         self._studies = list(studies)
         self.device_recovery = device_recovery
         self.enabled = bool(enabled)
+        # the serving mesh: ledger records of SHARDED programs replay
+        # against it (topology-aware warmup); the predicted study
+        # probes already carry it via Study.prepare
+        self.mesh = mesh
         self._lock = threading.Lock()
         self._items = []  # guarded-by: _lock
         self._planned = False  # guarded-by: _lock
@@ -589,7 +676,7 @@ class WarmupDriver:
                     rec.get("replay_key"), "ledger",
                     est_s=float(rec.get("duration_s") or 0.0) or None,
                 )
-                requests = requests_from_record(rec)
+                requests = requests_from_record(rec, mesh=self.mesh)
                 if requests is None:
                     item.state = STATE_SKIPPED
                     item.detail = "record not replayable"

@@ -773,6 +773,17 @@ class DeviceStats:
         self._sigs = {}  # guarded-by: _lock
         self._sig_drops = 0  # guarded-by: _lock
         self._last = None  # guarded-by: _lock
+        # per-device split (mesh execution mode): busy seconds and
+        # dispatch counts per chip label ('<platform>:<id>') — a
+        # dispatch's device interval is attributed to EVERY chip its
+        # program spanned, so a chip left out of the mesh (or only
+        # reached by single-chip traffic) shows as the cold/hot one
+        # instead of blending into one average.  Allocator peaks are
+        # genuinely per-chip (each device reports its own memory_stats).
+        self._busy_by_device = defaultdict(float)  # guarded-by: _lock
+        self._n_by_device = defaultdict(int)  # guarded-by: _lock
+        self._live_hw_by_device = defaultdict(int)  # guarded-by: _lock
+        self._backend_peak_by_device = {}  # guarded-by: _lock
 
     def record_dispatch(self, rec: dict):
         """One completed fused dispatch (record shape documented in
@@ -801,6 +812,15 @@ class DeviceStats:
                     self._pct_n[str(ceiling)] += 1
             if live > self._live_bytes_hw:
                 self._live_bytes_hw = live
+            for dev in rec.get("devices") or ():
+                dev = str(dev)
+                self._busy_by_device[dev] += device_s
+                self._n_by_device[dev] += 1
+                # upper bound per chip: replicated history buffers are
+                # resident full-size on every mesh device; only the
+                # sharded scoring intermediates split
+                if live > self._live_hw_by_device[dev]:
+                    self._live_hw_by_device[dev] = live
             self._last = dict(rec)
             self._recent.append(dict(rec))
             sig = str(rec.get("sig", "?"))
@@ -829,9 +849,11 @@ class DeviceStats:
             if ceiling is not None:
                 agg["ceilings"][str(ceiling)] += 1
 
-    def set_backend_peak_bytes(self, nbytes):
+    def set_backend_peak_bytes(self, nbytes, device=None):
         """Record the backend allocator's peak (``Device.memory_stats()
-        ['peak_bytes_in_use']`` where available — TPU yes, CPU no)."""
+        ['peak_bytes_in_use']`` where available — TPU yes, CPU no).
+        With ``device`` (a '<platform>:<id>' label) the peak is ALSO
+        tracked per chip — the mesh-mode skew signal."""
         if nbytes is None:
             return
         with self._lock:
@@ -840,6 +862,10 @@ class DeviceStats:
                 or nbytes > self._backend_peak_bytes
             ):
                 self._backend_peak_bytes = int(nbytes)
+            if device is not None:
+                prev = self._backend_peak_by_device.get(str(device))
+                if prev is None or nbytes > prev:
+                    self._backend_peak_by_device[str(device)] = int(nbytes)
 
     @property
     def n_dispatches(self) -> int:
@@ -875,6 +901,46 @@ class DeviceStats:
             return None
         elapsed = time.monotonic() - self._t_started
         return min(busy / elapsed, 1.0) if elapsed > 0 else None
+
+    def duty_cycle_by_device(self) -> dict:
+        """{device_label: busy fraction of wall time} over the chips
+        any observed dispatch spanned (same clamp semantics as the
+        blended :meth:`duty_cycle`)."""
+        with self._lock:
+            busy = dict(self._busy_by_device)
+        elapsed = time.monotonic() - self._t_started
+        if elapsed <= 0:
+            return {}
+        return {
+            dev: min(b / elapsed, 1.0) for dev, b in sorted(busy.items())
+        }
+
+    def per_device(self) -> dict:
+        """The per-chip telemetry rows: busy seconds, dispatch count,
+        duty cycle, live-buffer high-water (upper bound — replicated
+        buffers are full-size per chip), and the chip's own allocator
+        peak when the backend reports one."""
+        duty = self.duty_cycle_by_device()
+        with self._lock:
+            labels = set(self._busy_by_device) | set(
+                self._backend_peak_by_device
+            )
+            return {
+                dev: {
+                    "busy_s": round(self._busy_by_device.get(dev, 0.0), 6),
+                    "n_dispatches": self._n_by_device.get(dev, 0),
+                    "duty_cycle": (
+                        round(duty[dev], 6) if dev in duty else None
+                    ),
+                    "live_buffer_highwater_bytes": (
+                        self._live_hw_by_device.get(dev, 0)
+                    ),
+                    "backend_peak_bytes": (
+                        self._backend_peak_by_device.get(dev)
+                    ),
+                }
+                for dev in sorted(labels)
+            }
 
     def ceiling_counts(self) -> dict:
         with self._lock:
@@ -934,6 +1000,7 @@ class DeviceStats:
         duty = self.duty_cycle()
         pct = self.mean_roofline_pct()
         table = self.signature_table()
+        per_device = self.per_device()
         with self._lock:
             return {
                 "n_dispatches": self._n_dispatches,
@@ -955,6 +1022,7 @@ class DeviceStats:
                     "live_buffer_highwater_bytes": self._live_bytes_hw,
                     "backend_peak_bytes": self._backend_peak_bytes,
                 },
+                "per_device": per_device,
                 "signatures": table,
                 "signature_drops": self._sig_drops,
             }
@@ -1420,9 +1488,16 @@ def render_prometheus(
              "counter")
         sample("device_busy_seconds_total", None, s["busy_s"])
         head("device_duty_cycle",
-             "Device-busy fraction of wall time since stats start.",
-             "gauge")
+             "Device-busy fraction of wall time since stats start: the "
+             "unlabeled series blends all chips; {device=...} series "
+             "split per chip (mesh execution mode) — a chip only "
+             "reached by single-chip traffic, or skipped by the mesh, "
+             "shows as the outlier instead of blending in.", "gauge")
         sample("device_duty_cycle", None, s["duty_cycle"])
+        for dev, row in s["per_device"].items():
+            if row["duty_cycle"] is not None:
+                sample("device_duty_cycle", {"device": dev},
+                       row["duty_cycle"])
         head("device_hbm_bytes_total",
              "Modeled HBM bytes moved by observed dispatches.", "counter")
         sample("device_hbm_bytes_total", None, s["hbm_bytes_total"])
@@ -1443,8 +1518,11 @@ def render_prometheus(
             sample("device_roofline_pct", {"ceiling": ceiling}, pct)
         head("device_memory_highwater_bytes",
              "Memory high-water: live program buffers (inputs+output of "
-             "one dispatch) and backend allocator peak when reported.",
-             "gauge")
+             "one dispatch) and backend allocator peak when reported; "
+             "{device=...} series split per chip (allocator peaks are "
+             "genuinely per-chip; live-buffer rows are an upper bound — "
+             "replicated history buffers are full-size on every mesh "
+             "device).", "gauge")
         mem = s["memory"]
         sample("device_memory_highwater_bytes",
                {"kind": "live_buffers"},
@@ -1452,6 +1530,15 @@ def render_prometheus(
         if mem["backend_peak_bytes"] is not None:
             sample("device_memory_highwater_bytes",
                    {"kind": "backend_peak"}, mem["backend_peak_bytes"])
+        for dev, row in s["per_device"].items():
+            if row["live_buffer_highwater_bytes"]:
+                sample("device_memory_highwater_bytes",
+                       {"kind": "live_buffers", "device": dev},
+                       row["live_buffer_highwater_bytes"])
+            if row["backend_peak_bytes"] is not None:
+                sample("device_memory_highwater_bytes",
+                       {"kind": "backend_peak", "device": dev},
+                       row["backend_peak_bytes"])
 
     if study_health is not None:
         rows = study_health.get("rows", ())

@@ -47,9 +47,183 @@ def default_mesh(axis_names=("dp", "sp"), shape=None, devices=None):
             sp *= 2
         dp = n // sp
         shape = (dp, sp)
-    assert shape[0] * shape[1] == n, (shape, n)
+    if shape[0] * shape[1] != n:
+        raise ValueError(
+            f"mesh shape dp={shape[0]} x sp={shape[1]} needs "
+            f"{shape[0] * shape[1]} devices; {n} local device(s) available"
+        )
     arr = np.asarray(devices).reshape(shape)
     return Mesh(arr, axis_names)
+
+
+class DeviceMesh:
+    """The production mesh-execution mode of the fused suggest plane.
+
+    Wraps the topology decision — which local chips participate and in
+    what ``dp`` (candidates/batched studies) × ``sp`` (Parzen
+    components) layout — behind one object the drivers, the service
+    scheduler, and the observability planes all share:
+
+    - :meth:`auto` builds a mesh over EVERY local device with the
+      :func:`default_mesh` shape heuristic;
+    - :meth:`from_spec` parses the server flag grammar
+      (``auto`` | ``off`` | ``"DPxSP"`` / ``"DP,SP"``);
+    - :attr:`jax_mesh` is the underlying :class:`jax.sharding.Mesh` the
+      device programs shard over — ``None`` in the DEGENERATE case
+      (one device, or ``off``): the dispatch then runs the single-chip
+      program **bit-for-bit** (no sharding constraints, same jit cache
+      key as ``mesh=None`` always had);
+    - :meth:`topology` is the JSON-able identity (backend, device
+      count, dp, sp) the compile-ledger fingerprint and the metrics
+      plane stamp, so programs compiled under one topology are never
+      replayed onto another.
+
+    Hashable/comparable by topology + device set, so it can sit in jit
+    statics and cache keys exactly like the raw Mesh did.
+    """
+
+    __slots__ = ("jax_mesh", "dp", "sp", "devices")
+
+    def __init__(self, devices=None, shape=None):
+        devices = (
+            list(jax.devices()) if devices is None else list(devices)
+        )
+        if not devices:
+            raise ValueError("DeviceMesh needs at least one device")
+        self.devices = tuple(devices)
+        if len(devices) == 1 and shape in (None, (1, 1)):
+            # degenerate: exactly today's single-chip dispatch
+            self.jax_mesh = None
+            self.dp, self.sp = 1, 1
+        else:
+            self.jax_mesh = default_mesh(shape=shape, devices=devices)
+            self.dp = int(self.jax_mesh.shape["dp"])
+            self.sp = int(self.jax_mesh.shape["sp"])
+
+    # -- constructors --------------------------------------------------
+    @classmethod
+    def auto(cls, devices=None):
+        """A mesh over every local device (degenerate on one chip)."""
+        return cls(devices=devices)
+
+    @classmethod
+    def from_spec(cls, spec, devices=None):
+        """Parse the ``--mesh`` flag grammar.
+
+        ``None``/``"off"`` → None (single-chip dispatch), ``"auto"`` →
+        :meth:`auto`, ``"DPxSP"`` or ``"DP,SP"`` → that explicit shape
+        over the local devices (ValueError when the product does not
+        match the device count).  A DeviceMesh or jax Mesh passes
+        through untouched."""
+        if spec is None:
+            return None
+        if isinstance(spec, cls):
+            return spec
+        if isinstance(spec, Mesh):
+            return cls(
+                devices=list(np.asarray(spec.devices).flat),
+                shape=tuple(int(s) for s in np.asarray(spec.devices).shape),
+            )
+        token = str(spec).strip().lower()
+        if token in ("off", "none", ""):
+            return None
+        if token == "auto":
+            return cls.auto(devices=devices)
+        for sep in ("x", ","):
+            if sep in token:
+                parts = token.split(sep)
+                if len(parts) != 2:
+                    break
+                try:
+                    dp, sp = int(parts[0]), int(parts[1])
+                except ValueError:
+                    break
+                if dp < 1 or sp < 1:
+                    raise ValueError(f"mesh axes must be >= 1: {spec!r}")
+                devs = (
+                    list(jax.devices()) if devices is None
+                    else list(devices)
+                )
+                if dp * sp != len(devs):
+                    # never silently run on a subset: idle chips would
+                    # contradict every topology identity stamped from
+                    # this process (ledger fingerprint, /v1/status)
+                    raise ValueError(
+                        f"mesh spec {spec!r} covers {dp * sp} device(s) "
+                        f"but {len(devs)} are local; use an exact shape "
+                        f"or 'auto'"
+                    )
+                return cls(devices=devs, shape=(dp, sp))
+        raise ValueError(
+            f"bad mesh spec {spec!r}: expected 'auto', 'off', or 'DPxSP' "
+            f"(e.g. '4x2' or '4,2')"
+        )
+
+    # -- identity ------------------------------------------------------
+    @property
+    def n_devices(self) -> int:
+        return len(self.devices)
+
+    @property
+    def shape_str(self) -> str:
+        return f"{self.dp}x{self.sp}"
+
+    def device_labels(self):
+        """Stable per-chip labels ('<platform>:<id>') for the
+        per-device telemetry split."""
+        return [f"{d.platform}:{d.id}" for d in self.devices]
+
+    def topology(self) -> dict:
+        """The JSON-able topology identity (the compile-ledger
+        fingerprint contribution)."""
+        return {
+            "backend": str(self.devices[0].platform),
+            "device_count": self.n_devices,
+            "mesh": self.shape_str if self.jax_mesh is not None else "off",
+        }
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, DeviceMesh)
+            and self.devices == other.devices
+            and (self.dp, self.sp) == (other.dp, other.sp)
+        )
+
+    def __hash__(self):
+        return hash((self.devices, self.dp, self.sp))
+
+    def __repr__(self):
+        mode = "degenerate" if self.jax_mesh is None else self.shape_str
+        return f"DeviceMesh({mode}, n_devices={self.n_devices})"
+
+
+def resolve_mesh(mesh):
+    """Normalize every accepted ``mesh=`` input to what the device
+    plane dispatches on: a :class:`jax.sharding.Mesh`, or ``None`` for
+    the single-chip program.
+
+    Accepts None, a jax Mesh (passed through), a :class:`DeviceMesh`
+    (its ``jax_mesh`` — None when degenerate, keeping the one-device
+    case bit-for-bit on today's path), or a spec string
+    (``auto``/``off``/``DPxSP``)."""
+    if mesh is None or isinstance(mesh, Mesh):
+        return mesh
+    if isinstance(mesh, DeviceMesh):
+        return mesh.jax_mesh
+    dm = DeviceMesh.from_spec(mesh)
+    return None if dm is None else dm.jax_mesh
+
+
+def mesh_shape_str(mesh) -> str:
+    """'off' | 'DPxSP' for any accepted mesh form — the label the
+    dispatch spans and bench rows carry."""
+    if mesh is None:
+        return "off"
+    if isinstance(mesh, DeviceMesh):
+        return "off" if mesh.jax_mesh is None else mesh.shape_str
+    return "x".join(
+        str(int(mesh.shape[name])) for name in mesh.axis_names
+    )
 
 
 def _local_logsumexp_block(comp_ll, axis_name):

@@ -283,11 +283,50 @@ def signature_key(requests) -> str:
             if statics.get("log_scale"):
                 bits.append("log")
             if statics.get("mesh") is not None:
-                bits.append("mesh")
+                bits.append(f"mesh{_mesh_label(statics['mesh'])}")
         else:
             bits.append(f"u{int(statics.get('upper', 0) or 0)}")
         parts.append(f"{kind}[{','.join(bits)}]")
     return f"capt{capt}:" + "+".join(parts)
+
+
+def _mesh_label(mesh) -> str:
+    """'DPxSP' for a jax Mesh (sig-key + telemetry label)."""
+    try:
+        return "x".join(
+            str(int(mesh.shape[name])) for name in mesh.axis_names
+        )
+    except Exception:  # pragma: no cover - defensive
+        return "mesh"
+
+
+def dispatch_mesh(requests):
+    """The jax Mesh a fused request list would shard over (None for
+    single-chip) — every cont family of one suggest shares the one
+    mesh, so the first hit is THE mesh."""
+    for _, _, statics in requests:
+        mesh = statics.get("mesh")
+        if mesh is not None:
+            return mesh
+    return None
+
+
+def dispatch_devices(requests):
+    """Stable per-chip labels ('<platform>:<id>') of the devices the
+    fused program for ``requests`` runs on: the mesh's device set, or
+    the default device for a single-chip dispatch.  The per-device
+    telemetry split keys on these labels."""
+    import numpy as np
+
+    mesh = dispatch_mesh(requests)
+    if mesh is not None:
+        return [
+            f"{d.platform}:{d.id}" for d in np.asarray(mesh.devices).flat
+        ]
+    import jax
+
+    d = jax.devices()[0]
+    return [f"{d.platform}:{d.id}"]
 
 
 # ---------------------------------------------------------------------
@@ -398,13 +437,16 @@ class DeviceProfiler:
         try:
             sig_key = signature_key(requests)
             with self._lock:
-                cost = self._cost_cache.get(sig_key)
-            if cost is None:
+                cached = self._cost_cache.get(sig_key)
+            if cached is None:
                 cost = analytical_cost(requests)
+                devices = dispatch_devices(requests)
+                cached = (cost, devices)
                 with self._lock:
-                    self._cost_cache[sig_key] = cost
+                    self._cost_cache[sig_key] = cached
                     if self.keep_samples:
                         self._samples[sig_key] = requests
+            cost, devices = cached
             # live-buffer residency of this program: every device array
             # it reads (nbytes is shape metadata — no transfer)
             arg_bytes = 0
@@ -412,6 +454,14 @@ class DeviceProfiler:
                 for a in args:
                     arg_bytes += int(getattr(a, "nbytes", 0))
             peaks = self.peaks
+            if len(devices) > 1:
+                # mesh dispatch: the program spans len(devices) chips,
+                # so the aggregate ceilings scale with the mesh (the
+                # ridge point is unchanged — both axes scale together)
+                peaks = dict(peaks)
+                peaks["peak_tflops"] *= len(devices)
+                peaks["peak_hbm_GBps"] *= len(devices)
+                peaks["source"] = f"{peaks['source']}_x{len(devices)}"
             stats = self.stats
         except Exception:
             logger.warning("device profiler observe failed", exc_info=True)
@@ -437,17 +487,32 @@ class DeviceProfiler:
                     "live_bytes": arg_bytes + int(event.get("out_bytes", 0)),
                     "cost_source": cost["source"],
                     "compiled": bool(event.get("compiled", False)),
+                    "devices": list(devices),
                 }
                 if self._backend_mem:
                     try:
                         import jax
 
-                        mem = jax.devices()[0].memory_stats()
-                        if mem:
-                            stats.set_backend_peak_bytes(
-                                mem.get("peak_bytes_in_use")
-                            )
-                        else:
+                        # per-device allocator peaks: on a mesh every
+                        # participating chip reports its own — a skewed
+                        # shard shows up as ONE hot chip, not a blend
+                        any_mem = False
+                        all_devs = {
+                            f"{d.platform}:{d.id}": d
+                            for d in jax.devices()
+                        }
+                        for label in devices:
+                            dev = all_devs.get(label)
+                            if dev is None:
+                                continue
+                            mem = dev.memory_stats()
+                            if mem:
+                                any_mem = True
+                                stats.set_backend_peak_bytes(
+                                    mem.get("peak_bytes_in_use"),
+                                    device=label,
+                                )
+                        if not any_mem:
                             self._backend_mem = False
                     except Exception:
                         self._backend_mem = False

@@ -141,6 +141,16 @@ def make_parser():
              "when --root is set)",
     )
     p.add_argument(
+        "--mesh", default="off", dest="mesh",
+        help="mesh execution mode: 'auto' shards the fused suggest "
+             "programs across every local chip (dp x sp shape from the "
+             "device count), 'DPxSP' (e.g. 4x2) pins an explicit "
+             "shape, 'off' (default) keeps single-chip dispatch.  The "
+             "sharded program is trial-for-trial identical to the "
+             "single-chip one at the same seeds; one chip (or 'off') "
+             "is bit-for-bit today's path",
+    )
+    p.add_argument(
         "--chaos-config", default=None, dest="chaos_config",
         help="TESTING ONLY: JSON ChaosConfig activating seeded "
              "service-plane fault injection (torn writes, connection "
@@ -219,7 +229,13 @@ def main(argv=None):
         warmup=not options.no_warmup,
         cold_fallback=options.cold_fallback,
         compile_ledger_path=options.compile_ledger,
+        mesh=options.mesh,
     )
+    if service.mesh_label != "off":
+        logger.info(
+            "mesh execution mode: %s over %d local device(s)",
+            service.mesh_label, service.device_mesh.n_devices,
+        )
     # flight-recorder triggers beyond SLO breaches: SIGQUIT ("show me
     # what you were doing") and unhandled crashes (the post-mortem
     # always has its evidence).  --no-slo turns these off too: the

@@ -472,7 +472,7 @@ class Study:
     """
 
     def __init__(self, study_id, space, seed, algo_name="tpe",
-                 algo_params=None, trials=None):
+                 algo_params=None, trials=None, mesh=None):
         self.study_id = validate_study_id(study_id)
         self.space = space
         self.seed = int(seed)
@@ -481,6 +481,22 @@ class Study:
         self.algo, self._prepare = _resolve_algo(
             self.algo_name, self.algo_params
         )
+        # mesh execution mode: the SERVICE owns the device topology, so
+        # every study's fused prepare shards over the one shared mesh
+        # (suggestions are trial-for-trial identical to the single-chip
+        # program — see parallel.sharding / docs/sharding.md).  An
+        # explicit per-study algo_params["mesh"] wins over the service
+        # default (it was already bound by _resolve_algo's partial).
+        self.mesh = mesh
+        if (
+            mesh is not None
+            and self._prepare is not None
+            and "mesh" not in self.algo_params
+        ):
+            import inspect
+
+            if "mesh" in inspect.signature(self._prepare).parameters:
+                self._prepare = partial(self._prepare, mesh=mesh)
         self.domain = Domain(_null_objective, space)
         self.trials = trials if trials is not None else Trials()
         self.lock = threading.Lock()
@@ -834,9 +850,11 @@ class StudyRegistry:
     """
 
     # lock-order: _create_lock < _studies_lock
-    def __init__(self, root=None, max_studies=DEFAULT_MAX_STUDIES):
+    def __init__(self, root=None, max_studies=DEFAULT_MAX_STUDIES,
+                 mesh=None):
         self.root = os.path.abspath(root) if root else None
         self.max_studies = int(max_studies)
+        self.mesh = mesh  # the service's shared device mesh (or None)
         self._studies_lock = threading.Lock()
         # serializes whole create() calls: the capacity/exists check,
         # the on-disk side effects (study dir + config attachment), and
@@ -882,6 +900,7 @@ class StudyRegistry:
                     algo_name=cfg["algo_name"],
                     algo_params=cfg.get("algo_params") or {},
                     trials=trials,
+                    mesh=self.mesh,
                 )
                 # exactly-once recovery: re-apply journal entries whose
                 # effects never landed (crash between journal append and
@@ -953,6 +972,25 @@ class StudyRegistry:
             # space's real gate (compiles it, catches duplicate labels
             # etc.); the throwaway instance is cheap next to a create.
             _resolve_algo(str(algo_name), dict(algo_params or {}))
+            if "mesh" in (algo_params or {}):
+                # a per-study mesh may opt OUT of the service mesh
+                # ("off") or restate it — never introduce a different
+                # one: the scheduler fuses studies into ONE program, and
+                # one program cannot shard over two meshes (the device
+                # plane refuses such a fusion at dispatch, failing the
+                # whole batch; reject at create instead, side-effect
+                # free)
+                from ..parallel.sharding import mesh_shape_str, resolve_mesh
+
+                study_mesh = resolve_mesh(algo_params["mesh"])
+                if study_mesh is not None and study_mesh != self.mesh:
+                    raise ValueError(
+                        f"algo_params['mesh'] resolves to "
+                        f"{mesh_shape_str(study_mesh)!r} but this server "
+                        f"dispatches over {mesh_shape_str(self.mesh)!r}; "
+                        f"per-study meshes may only be 'off' or match "
+                        f"the server's --mesh"
+                    )
             Domain(_null_objective, space)
             trials = None
             if self.root:
@@ -962,7 +1000,7 @@ class StudyRegistry:
             study = Study(
                 study_id, space, seed,
                 algo_name=algo_name, algo_params=algo_params,
-                trials=trials,
+                trials=trials, mesh=self.mesh,
             )
             study.persist_config()
             with self._studies_lock:
@@ -1084,12 +1122,15 @@ class SuggestScheduler:
     def __init__(self, stats: ServiceStats = None, device_recovery=None,
                  batch_window=DEFAULT_BATCH_WINDOW,
                  max_batch=DEFAULT_MAX_BATCH, max_queue=DEFAULT_MAX_QUEUE,
-                 cold_fallback=False):
+                 cold_fallback=False, mesh_label="off"):
         self.batch_window = float(batch_window)
         self.max_batch = int(max_batch)
         self.max_queue = int(max_queue)
         self.stats = stats if stats is not None else ServiceStats()
         self.device_recovery = device_recovery
+        # the serving mesh shape ("off" | "DPxSP") — stamped on every
+        # device.dispatch span so a trace says which topology ran it
+        self.mesh_label = str(mesh_label)
         # cold containment (OFF by default — it trades trajectory
         # determinism for tail latency): when the fused program a batch
         # would dispatch has not been traced yet, serve the batch from
@@ -1419,6 +1460,7 @@ class SuggestScheduler:
                 "device.dispatch", t_launch0, t_launch1,
                 parent=p.parent_span, batch_size=n_batch, shared=True,
                 pro_rata_s=round((t_launch1 - t_launch0) / n_batch, 9),
+                mesh=self.mesh_label,
             )
             sp.update_attrs(roof_attrs)
             p.trace.record_span(
@@ -1606,8 +1648,22 @@ class OptimizationService:
                  slo_enabled=True, slo_rules=None, flight_dir=None,
                  slo_tick=None, compile_cache_dir=None, warmup=True,
                  cold_fallback=False, compile_ledger_path=None,
-                 compile_plane=True):
+                 compile_plane=True, mesh=None):
         self.stats = ServiceStats()
+        # mesh execution mode (--mesh auto|DPxSP|off): resolve the spec
+        # ONCE — every study's fused prepare, the warmup replay, and
+        # the ledger topology fingerprint share this mesh.  A
+        # single-device "auto" resolves to the degenerate mesh, i.e.
+        # exactly the single-chip dispatch (bit-for-bit).
+        from ..parallel.sharding import (
+            DeviceMesh,
+            mesh_shape_str,
+            resolve_mesh,
+        )
+
+        self.device_mesh = DeviceMesh.from_spec(mesh)
+        self.mesh = resolve_mesh(self.device_mesh)
+        self.mesh_label = mesh_shape_str(self.mesh)
         # compile plane (hyperopt_tpu.compile_ledger) — wired FIRST so
         # the persistent XLA cache covers every compile this process
         # pays (the warmup replay included) and the ledger recorder
@@ -1616,6 +1672,10 @@ class OptimizationService:
         # overhead A/B's baseline arm, mirroring slo_enabled=False.
         from .. import compile_ledger as ledger_mod
 
+        # stamp the serving topology into the compile-plane fingerprint
+        # BEFORE any recording: single-chip ledger entries must never
+        # be replayed onto a mesh (and vice versa)
+        ledger_mod.set_topology(self.mesh)
         self.compile_plane = bool(compile_plane)
         if not self.compile_plane:
             compile_cache_dir = None
@@ -1686,7 +1746,9 @@ class OptimizationService:
         self._recovery_ok = True
         if root and startup_fsck:
             self._run_startup_fsck(root)
-        self.registry = StudyRegistry(root, max_studies=max_studies)
+        self.registry = StudyRegistry(
+            root, max_studies=max_studies, mesh=self.mesh
+        )
         if self.registry.recovery_info["failed_studies"]:
             self._recovery_ok = False
         # the gauge must reflect RECOVERED studies too, not just creates
@@ -1700,6 +1762,7 @@ class OptimizationService:
             studies=self.registry.studies(),
             device_recovery=self.device_recovery,
             enabled=bool(warmup),
+            mesh=self.mesh,
         )
         self.warmup.start()
         # SLO guardrails + flight recorder: the component that WATCHES
@@ -1756,6 +1819,7 @@ class OptimizationService:
             max_batch=max_batch,
             max_queue=max_queue,
             cold_fallback=cold_fallback,
+            mesh_label=self.mesh_label,
         )
         self.suggest_timeout = float(suggest_timeout)
         self.started_at = time.time()
@@ -2065,6 +2129,13 @@ class OptimizationService:
             "draining": self._closed,
             "stats": self.stats.summary(),
             "faults": self.fault_stats.summary(),
+            "mesh": {
+                "label": self.mesh_label,
+                "topology": (
+                    self.device_mesh.topology()
+                    if self.device_mesh is not None else None
+                ),
+            },
             "device": self.device_stats.summary(),
             "store": self.store_stats.summary(),
             "slo_breaching": self.slo.current_breaching(),

@@ -25,14 +25,22 @@ and ``limiter`` names the largest share.  The decade where
 where the device itself — not per-call overhead — becomes the
 bottleneck.
 
-Writes one JSON line (commit as BENCH_TPU_batched.json when captured on
-hardware):
-  {"platform": "tpu", "n_history": 10000, "rows":
-    [{"k": 32, "suggests_per_sec": ..., "ms_per_suggest_call": ...,
-      "dispatch_ms": ..., "readback_ms": ..., "host_ms": ...,
-      "limiter": "..."}, ...]}
+**Mesh arms** (``--mesh auto`` / ``--mesh DPxSP``, ISSUE 11): each k is
+additionally timed with the fused program sharded across the mesh
+(candidates over dp, Parzen components over sp — trial-for-trial
+identical suggestions, see docs/sharding.md), and every row carries
+**per-device limiter attribution**: each participating chip's dispatch
+count, busy-ms mean, and duty cycle over the timed window, so a skewed
+shard shows up as one hot chip.  Off-TPU, force a virtual mesh with
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (the CI proof).
 
-Run:  python scripts/batched_suggest_sweep.py            (TPU via tunnel)
+Writes one JSON line.  Without ``--mesh`` the output keeps the
+BENCH_TPU_batched.json shape (single-arm rows); with mesh arms it is
+the BENCH_TPU_sharded.json shape: ``rows`` carry a ``"mesh"`` field
+("off" | "DPxSP") per (k, arm) and ``"per_device"`` maps.
+
+Run:  python scripts/batched_suggest_sweep.py              (single-chip)
+      python scripts/batched_suggest_sweep.py --mesh auto  (off + mesh arms)
       BENCH_SWEEP_KS=8,32 python scripts/batched_suggest_sweep.py
 """
 
@@ -51,80 +59,127 @@ KS = tuple(
 REPS = int(os.environ.get("BENCH_SWEEP_REPS", 5))
 
 
-def main():
+def _arm_label(mesh):
+    from hyperopt_tpu.parallel.sharding import mesh_shape_str
+
+    return mesh_shape_str(mesh)
+
+
+def run_sweep(ks=KS, reps=REPS, mesh_arms=(None,), n_history=None,
+              n_cand=None):
+    """The sweep body: one process, one warm history, rows per
+    (k, mesh arm).  ``mesh_arms`` entries are anything
+    ``tpe.suggest(mesh=...)`` accepts (None = single-chip)."""
     import jax
 
     import bench
     from hyperopt_tpu import profiling
+    from hyperopt_tpu.algos import tpe
     from hyperopt_tpu.observability import DeviceStats
+    from hyperopt_tpu.parallel.sharding import resolve_mesh
 
     platform = jax.devices()[0].platform
-    domain, trials = bench.build_history_trials()
-    from hyperopt_tpu.algos import tpe
+    n_history = bench.N_HISTORY if n_history is None else int(n_history)
+    n_cand = bench.N_EI_CANDIDATES if n_cand is None else int(n_cand)
+    domain, trials = bench.build_history_trials(n_history=n_history)
 
-    n_cand = bench.N_EI_CANDIDATES
+    arms = [resolve_mesh(m) for m in mesh_arms]
     rows = []
-    next_id = bench.N_HISTORY
-    for k in KS:
-        # warm: compile the k-sized batch program outside the timed
-        # window (and outside the profiler — the timed stats must hold
-        # steady-state dispatches only)
-        ids = list(range(next_id, next_id + k))
-        next_id += k
-        tpe.suggest(ids, domain, trials, 0, n_EI_candidates=n_cand, verbose=False)
-        stats = DeviceStats()
-        with profiling.DeviceProfiler(stats=stats):
-            t0 = time.perf_counter()
-            for r in range(REPS):
-                ids = list(range(next_id, next_id + k))
-                next_id += k
-                tpe.suggest(
-                    ids, domain, trials, r + 1, n_EI_candidates=n_cand,
-                    verbose=False,
-                )
-            per_call = (time.perf_counter() - t0) / REPS
-        s = stats.summary()
-        n = max(s["n_dispatches"], 1)
-        dispatch_ms = s["launch_s"] / n * 1e3
-        readback_ms = s["readback_s"] / n * 1e3
-        host_ms = max(per_call * 1e3 - dispatch_ms - readback_ms, 0.0)
-        shares = {
-            "dispatch": dispatch_ms,
-            "device_readback": readback_ms,
-            "host": host_ms,
-        }
-        rows.append(
-            {
-                "k": k,
-                "suggests_per_sec": round(k / per_call, 2),
-                "ms_per_suggest_call": round(per_call * 1e3, 2),
-                "dispatch_ms": round(dispatch_ms, 2),
-                "readback_ms": round(readback_ms, 2),
-                "host_ms": round(host_ms, 2),
-                "limiter": max(shares, key=shares.get),
-                "n_dispatches_observed": s["n_dispatches"],
-                "binding_ceiling": (
-                    s["signatures"][0]["binding_ceiling"]
-                    if s["signatures"] else None
-                ),
+    next_id = n_history
+    for mesh in arms:
+        label = _arm_label(mesh)
+        for k in ks:
+            # warm: compile the (k, mesh) batch program outside the
+            # timed window (and outside the profiler — the timed stats
+            # must hold steady-state dispatches only)
+            ids = list(range(next_id, next_id + k))
+            next_id += k
+            tpe.suggest(ids, domain, trials, 0, n_EI_candidates=n_cand,
+                        mesh=mesh, verbose=False)
+            stats = DeviceStats()
+            with profiling.DeviceProfiler(stats=stats):
+                t0 = time.perf_counter()
+                for r in range(reps):
+                    ids = list(range(next_id, next_id + k))
+                    next_id += k
+                    tpe.suggest(
+                        ids, domain, trials, r + 1, n_EI_candidates=n_cand,
+                        mesh=mesh, verbose=False,
+                    )
+                per_call = (time.perf_counter() - t0) / reps
+            s = stats.summary()
+            n = max(s["n_dispatches"], 1)
+            dispatch_ms = s["launch_s"] / n * 1e3
+            readback_ms = s["readback_s"] / n * 1e3
+            host_ms = max(per_call * 1e3 - dispatch_ms - readback_ms, 0.0)
+            shares = {
+                "dispatch": dispatch_ms,
+                "device_readback": readback_ms,
+                "host": host_ms,
             }
-        )
-        print(
-            f"# k={k}: {rows[-1]['suggests_per_sec']}/s "
-            f"limiter={rows[-1]['limiter']} "
-            f"(dispatch {rows[-1]['dispatch_ms']}ms / readback "
-            f"{rows[-1]['readback_ms']}ms / host {rows[-1]['host_ms']}ms)",
-            file=sys.stderr,
-        )
+            per_device = {
+                dev: {
+                    "n_dispatches": row["n_dispatches"],
+                    "busy_ms_mean": round(
+                        row["busy_s"] / max(row["n_dispatches"], 1) * 1e3, 3
+                    ),
+                    "duty_cycle": row["duty_cycle"],
+                }
+                for dev, row in s["per_device"].items()
+            }
+            rows.append(
+                {
+                    "k": k,
+                    "mesh": label,
+                    "suggests_per_sec": round(k / per_call, 2),
+                    "ms_per_suggest_call": round(per_call * 1e3, 2),
+                    "dispatch_ms": round(dispatch_ms, 2),
+                    "readback_ms": round(readback_ms, 2),
+                    "host_ms": round(host_ms, 2),
+                    "limiter": max(shares, key=shares.get),
+                    "n_dispatches_observed": s["n_dispatches"],
+                    "per_device": per_device,
+                    "binding_ceiling": (
+                        s["signatures"][0]["binding_ceiling"]
+                        if s["signatures"] else None
+                    ),
+                }
+            )
+            print(
+                f"# mesh={label} k={k}: {rows[-1]['suggests_per_sec']}/s "
+                f"limiter={rows[-1]['limiter']} "
+                f"(dispatch {rows[-1]['dispatch_ms']}ms / readback "
+                f"{rows[-1]['readback_ms']}ms / host "
+                f"{rows[-1]['host_ms']}ms, "
+                f"{len(per_device)} device(s))",
+                file=sys.stderr,
+            )
 
-    out = {
-        "metric": f"tpe_batched_suggests_per_sec_{bench.N_HISTORY}_history",
+    sharded = any(m is not None for m in arms)
+    return {
+        "metric": (
+            f"tpe_sharded_suggests_per_sec_{n_history}_history" if sharded
+            else f"tpe_batched_suggests_per_sec_{n_history}_history"
+        ),
         "platform": platform,
-        "n_history": bench.N_HISTORY,
+        "n_devices": int(jax.device_count()),
+        "mesh_arms": [_arm_label(m) for m in arms],
+        "n_history": n_history,
         "n_EI_candidates": n_cand,
-        "reps_per_k": REPS,
+        "reps_per_k": reps,
         "rows": rows,
     }
+
+
+def main():
+    argv = sys.argv[1:]
+    mesh_arms = [None]
+    if "--mesh" in argv:
+        spec = argv[argv.index("--mesh") + 1]
+        # the sharded artifact always carries the single-chip arm too:
+        # the headline IS the off-vs-mesh ratio at each k
+        mesh_arms = [None, spec]
+    out = run_sweep(mesh_arms=mesh_arms)
     print(json.dumps(out))
 
 
