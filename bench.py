@@ -543,23 +543,39 @@ def wallclock_section(argv):
 
 def lint_section(argv):
     """``python bench.py --lint [--quick]``: static-analysis smoke —
-    self-lint (race + static program passes) plus a short recompilation
-    audit of the fused TPE suggest program on CPU (100 trials, 40 with
-    ``--quick``; the full 200-trial tier runs via ``scripts/lint.py
-    --audit``).  Prints ONE JSON line like the other bench sections."""
+    self-lint (race + durability passes over the auto-discovered
+    package surface + static program checks incl. partition pin sites
+    and dispatch containers) plus a short recompilation audit of the
+    fused TPE suggest program on CPU (100 trials, 40 with ``--quick``;
+    the full 200-trial tier runs via ``scripts/lint.py --audit``).
+    Prints ONE JSON line like the other bench sections."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     n_audit = 40 if "--quick" in argv else 100
     t0 = time.time()
-    from hyperopt_tpu.analysis import Severity, audit_tpe_run, lint_repo
+    from hyperopt_tpu.analysis import (
+        Severity,
+        audit_tpe_run,
+        discover_race_files,
+        lint_repo,
+        package_files,
+    )
 
-    diags = lint_repo(static_only=True)
+    pkg = package_files()
+    race_files = discover_race_files(paths=pkg)
+    diags = lint_repo(static_only=True, paths=pkg, race_paths=race_files)
     aud = audit_tpe_run(n_trials=n_audit)
     diags += aud.diagnostics()
+    n_err = sum(1 for d in diags if d.severity == Severity.ERROR)
     out = {
         "metric": "lint_smoke",
         "value": len(diags),
         "unit": "diagnostics",
-        "errors": sum(1 for d in diags if d.severity == Severity.ERROR),
+        "ok": n_err == 0,
+        "errors": n_err,
+        # coverage of the auto-discovered pass surfaces (the old
+        # hand-registry could silently shrink; these cannot)
+        "race_files": len(race_files),
+        "durability_files": len(pkg),
         "audit_trials": n_audit,
         "audit_traces": aud.n_traces,
         "audit_program_keys": aud.n_programs,
@@ -569,7 +585,7 @@ def lint_section(argv):
     if diags:
         out["rules"] = sorted({d.rule for d in diags})
     print(json.dumps(out))
-    return 0
+    return 0 if n_err == 0 else 1
 
 
 def chaos_section(argv):

@@ -7,14 +7,24 @@ Targets:
   object (default: every module-level attribute that looks like a
   space: a dict of pyll nodes or a pyll Apply named ``space``/``SPACE``).
 - ``program [--audit [N]] [--static-only]`` — program-lint the fused
-  suggest programs; ``--audit`` additionally runs the N-trial (default
-  200) recompilation audit on CPU.
-- ``race <file.py> ...`` — guarded-by / lock-order check of source
-  files (default: the repo's own concurrent layers).
-- ``self`` — everything scripts/lint.py runs in CI: race pass over the
-  repo's pipeline/file_trials/jax_trials + static program audit.
+  suggest programs (donation contract, partition pin sites, dispatch
+  containers; the live tier adds the jaxpr trace + the PL206/PL207
+  partition audit on the virtual mesh); ``--audit`` additionally runs
+  the N-trial (default 200) recompilation audit on CPU.
+- ``race [file.py ...]`` — guarded-by / lock-order / lock-graph check
+  (default: every auto-discovered lock-bearing module of the package).
+- ``durability [file.py ...]`` — crash-consistency check of every
+  durable-write site (default: every package module).
+- ``self`` — the static tier scripts/lint.py gates CI on: race +
+  durability passes over the whole package + static program checks.
+- ``all`` — everything: ``self`` plus the live jaxpr trace and the
+  partition audit on the virtual mesh (imports jax).
 - a bare ``foo.py`` / ``pkg.module`` argument — inferred: ``.py`` file
-  → race pass; importable module → space pass.
+  → race + durability passes; importable module → space pass.
+
+``--json`` replaces the human report with the stable machine-readable
+schema ``[{rule, severity, file, line, message, hint}]`` (sorted), so
+CI and control loops can consume results programmatically.
 
 Exit code: number of ERROR-severity diagnostics (capped at 125), so
 ``&&`` chains and CI steps can gate on it; ``--no-fail`` forces 0.
@@ -23,14 +33,18 @@ Exit code: number of ERROR-severity diagnostics (capped at 125), so
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
 from . import (
+    diagnostics_json,
     format_report,
     import_module_target,
+    lint_durability,
     lint_programs,
     lint_races,
+    lint_repo,
     lint_space,
     looks_like_space,
     sort_diagnostics,
@@ -76,10 +90,18 @@ def main(argv=None) -> int:
                     help="program pass: skip the live jaxpr trace")
     ap.add_argument("--suppress", default="",
                     help="comma-separated rule ids to suppress")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the stable machine-readable schema "
+                         "[{rule, severity, file, line, message, hint}] "
+                         "instead of the human report")
     ap.add_argument("--no-fail", action="store_true",
                     help="always exit 0 (report-only mode)")
     args = ap.parse_args(argv)
     suppress = tuple(x.strip() for x in args.suppress.split(",") if x.strip())
+
+    def report(ds, header):
+        if not args.as_json:
+            print(format_report(ds, header=header))
 
     target = args.target or ["self"]
     cmd, rest = target[0], target[1:]
@@ -91,39 +113,55 @@ def main(argv=None) -> int:
             for name, space in _spaces_from(spec):
                 ds = lint_space(space, suppress=suppress)
                 diags.extend(ds)
-                print(format_report(ds, header=f"== {name}"))
-        print(_summary(diags))
+                report(ds, f"== {name}")
+        if not args.as_json:
+            print(_summary(diags))
     elif cmd == "program":
         diags = lint_programs(static_only=args.static_only,
                               suppress=suppress)
         if args.audit is not None:
             aud = audit_tpe_run(n_trials=args.audit)
             diags.extend(aud.diagnostics(suppress=suppress))
-            print(
-                f"recompilation audit: {aud.n_traces} trace(s) across "
-                f"{aud.n_programs} program key(s); "
-                f"buckets={aud.bucket_summary()}"
-            )
-        print(format_report(diags, header="== program_lint"))
+            if not args.as_json:
+                print(
+                    f"recompilation audit: {aud.n_traces} trace(s) across "
+                    f"{aud.n_programs} program key(s); "
+                    f"buckets={aud.bucket_summary()}"
+                )
+        report(diags, "== program_lint")
     elif cmd == "race":
         diags = lint_races(rest or None, suppress=suppress)
-        print(format_report(diags, header="== race_lint"))
-    elif cmd == "self":
-        diags = lint_races(suppress=suppress)
-        diags.extend(lint_programs(static_only=True, suppress=suppress))
-        print(format_report(diags, header="== self-lint (race + program)"))
+        report(diags, "== race_lint")
+    elif cmd == "durability":
+        diags = lint_durability(rest or None, suppress=suppress)
+        report(diags, "== durability_lint")
+    elif cmd in ("self", "all"):
+        # `self` = the static tier CI gates on; `all` additionally
+        # traces the live program (jaxpr + partition audit on the
+        # virtual mesh) unless --static-only
+        static_only = cmd == "self" or args.static_only
+        diags = lint_repo(static_only=static_only, suppress=suppress)
+        report(
+            diags,
+            "== self-lint (race + durability + program"
+            + (", static)" if static_only else " + live trace)"),
+        )
     else:
-        # inference: .py file -> race pass; importable module -> space
+        # inference: .py file -> race + durability; module -> space
         if cmd.endswith(".py") and os.path.exists(cmd):
             diags = lint_races(target, suppress=suppress)
-            print(format_report(diags, header="== race_lint"))
+            diags.extend(lint_durability(target, suppress=suppress))
+            report(diags, "== race + durability")
         else:
             for spec in target:
                 for name, space in _spaces_from(spec):
                     ds = lint_space(space, suppress=suppress)
                     diags.extend(ds)
-                    print(format_report(ds, header=f"== {name}"))
-            print(_summary(diags))
+                    report(ds, f"== {name}")
+            if not args.as_json:
+                print(_summary(diags))
+    if args.as_json:
+        print(json.dumps(diagnostics_json(diags), indent=1))
     if args.no_fail:
         return 0
     return min(sum(1 for d in diags if d.severity == Severity.ERROR), 125)

@@ -6,8 +6,9 @@ path for spaces, ``file:line`` for source), message, fix hint — so one
 reporter, one suppression mechanism, and one CI gate serve all three.
 
 Rule ids are namespaced by pass: ``SP1xx`` space rules, ``PL2xx``
-program rules, ``RL3xx`` race rules.  The catalog below is the single
-source of truth; ``docs/static_analysis.md`` renders it.
+program rules (including the PL206–PL208 partition-safety rules),
+``RL3xx`` race rules, ``DL4xx`` durability rules.  The catalog below is
+the single source of truth; ``docs/static_analysis.md`` renders it.
 
 Suppression:
 
@@ -19,9 +20,30 @@ Suppression:
 
 from __future__ import annotations
 
+import ast
 import re
 from dataclasses import dataclass, field
 from typing import Iterable, List, Optional, Tuple
+
+
+# One definition of "this name smells like a lock" shared by the race
+# and durability passes, so both draw the same lock boundaries.
+LOCKISH_RE = re.compile(r"lock|mutex|cond|cv\b|sem", re.IGNORECASE)
+
+
+def dotted_chain(node: ast.AST) -> Tuple[str, ...]:
+    """Dotted chain of an attribute/name expression, outermost-first:
+    ``('os', 'replace')`` for ``os.replace``, ``('self', '_thread',
+    'join')`` for ``self._thread.join``, ``('join',)`` when the root is
+    dynamic (a call result, subscript, ...).  Shared by the AST passes
+    so call-target matching stays consistent across them."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return tuple(reversed(parts))
 
 
 class Severity:
@@ -140,6 +162,32 @@ RULES = {
             "key leaks a per-call value, and every suggest pays a "
             "recompile instead of O(log N) compiles per run.",
         ),
+        Rule(
+            "PL206", Severity.ERROR, "missing-replicated-pin",
+            "A replicated with_sharding_constraint(PartitionSpec()) "
+            "pin required by the mesh determinism/miscompile contract "
+            "is missing: at fused-program entry, at the candidate "
+            "draw, or on either side of the sharded pair scorer.  "
+            "Without the pins XLA's SPMD partitioner back-propagates "
+            "shardings into the single-chip fit/sample program, which "
+            "this build partitions incorrectly.",
+        ),
+        Rule(
+            "PL207", Severity.ERROR, "sharded-unequal-concat",
+            "A sharded (non-replicated) value reaches an unequal-size "
+            "concatenate (the pair_params Kb+Ka concat class): the "
+            "SPMD partitioner splits the unequal operands "
+            "inconsistently and the scores silently diverge from the "
+            "single-chip program.",
+        ),
+        Rule(
+            "PL208", Severity.ERROR, "unnormalized-dispatch-container",
+            "A dispatch call site hands the fused suggest program a "
+            "request whose args ride in a list instead of the "
+            "normalized tuple form: the container type is part of the "
+            "jit pytree key, so the same workload silently retraces "
+            "per call.",
+        ),
         # -- race_lint -------------------------------------------------
         Rule(
             "RL301", Severity.ERROR, "unguarded-access",
@@ -158,6 +206,65 @@ RULES = {
             "A '# guarded-by:' annotation names a lock that is never "
             "assigned in the class: the annotation is stale or "
             "misspelled, so the discipline it declares is unchecked.",
+        ),
+        Rule(
+            "RL304", Severity.ERROR, "lock-cycle",
+            "The observed lock-acquisition graph (nested 'with' "
+            "blocks plus same-scope method calls made under a lock) "
+            "contains a cycle: two threads walking the cycle from "
+            "different entry points deadlock.",
+        ),
+        Rule(
+            "RL305", Severity.WARNING, "blocking-call-under-lock",
+            "A blocking call (fsync, HTTP, device dispatch/readback, "
+            "thread join) is made while holding a lock: every thread "
+            "contending on that lock stalls behind the disk/network/"
+            "device, and a join can deadlock against the joined "
+            "thread taking the same lock.",
+        ),
+        Rule(
+            "RL306", Severity.ERROR, "unregistered-lock-module",
+            "A module constructs a threading.Lock/RLock/Condition but "
+            "carries no guarded-by annotations and is not explicitly "
+            "exempted: its lock discipline is invisible to the race "
+            "pass, so violations in it can never be caught.",
+        ),
+        # -- durability_lint ---------------------------------------------
+        Rule(
+            "DL401", Severity.ERROR, "truncate-live-path",
+            "A live (non-tmp) file is opened with a truncating mode: "
+            "a crash between the truncate and the write leaves the "
+            "path EMPTY (the ids.counter tear class — duplicate trial "
+            "ids on restart).  Durable writes must go write-tmp -> "
+            "fsync -> os.replace.",
+        ),
+        Rule(
+            "DL402", Severity.ERROR, "replace-without-fsync",
+            "os.replace/os.rename publishes a tmp file written in the "
+            "same function without an fsync on the source handle: "
+            "after a power loss the rename can survive while the data "
+            "does not, leaving a durable path pointing at garbage.",
+        ),
+        Rule(
+            "DL403", Severity.ERROR, "unframed-journal-append",
+            "An O_APPEND journal append is not CRC-framed or is built "
+            "from multiple write() calls: a torn append becomes "
+            "indistinguishable from a valid record (or tears across "
+            "records), defeating the resync-on-load discipline.",
+        ),
+        Rule(
+            "DL404", Severity.WARNING, "dangling-tmp",
+            "A tmp file is created outside the atomic-replace idiom "
+            "(no os.replace publishing it in the same function): "
+            "either the write is not actually atomic, or droppings "
+            "accumulate forever.",
+        ),
+        Rule(
+            "DL405", Severity.ERROR, "unlocked-read-modify-write",
+            "A shared file is read and then rewritten in the same "
+            "function with no lock and no O_APPEND: two concurrent "
+            "writers interleave read-modify-write and one update is "
+            "silently lost.",
         ),
     ]
 }

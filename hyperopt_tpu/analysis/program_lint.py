@@ -1,6 +1,6 @@
 """Pass 2: lint of the fused device suggest programs.
 
-Three layers of checking over ``algos/tpe_device.py`` + ``ops/``:
+Four layers of checking over ``algos/tpe_device.py`` + ``ops/``:
 
 1. **Static donation audit** (no jax needed): the delta-apply program on
    the history-append path must donate its state buffers
@@ -23,6 +23,29 @@ Three layers of checking over ``algos/tpe_device.py`` + ``ops/``:
    device program traced more than once for the same (trial-count
    bucket, family signature) — the symptom of a per-call value leaking
    into the jit cache key (PL205).
+
+4. **Partition safety** (PL206–PL208) — the mesh determinism/miscompile
+   contract of the sharded suggest plane:
+
+   - :func:`lint_pin_sites` (static): the replicated
+     ``with_sharding_constraint(PartitionSpec())`` pins must exist at
+     the fused-program entry (``_build_multi_run``), around the
+     candidate draw (``_family_suggest_core``), and on both sides of
+     ``_sharded_pair_apply`` — PL206 when a site loses its pins.
+   - :func:`lint_partition_program` (live): traces the production
+     program under a virtual 8-device CPU mesh and verifies the
+     contract AT THE JAXPR LEVEL — every program input first consumed
+     by a replicated constraint, every ``shard_map`` operand pinned and
+     its output re-pinned, every non-replicated constraint reached
+     through a replicated one (PL206); and a forward taint walk proving
+     no sharded value reaches an unequal-size ``concatenate`` (the
+     ``pair_params`` Kb+Ka concat the SPMD partitioner miscompiles) —
+     PL207.
+   - :func:`lint_dispatch_callers` (static): every
+     ``multi_family_suggest_async`` / ``multi_study_suggest_async``
+     call site in the package must hand request args in the normalized
+     tuple form — a list container silently retraces the fused program
+     per call (PL208, the PR 10 pytree-key class).
 """
 
 from __future__ import annotations
@@ -32,7 +55,12 @@ import os
 from functools import partial
 from typing import Dict, List, Optional, Tuple
 
-from .diagnostics import Diagnostic, apply_suppressions, make
+from .diagnostics import (
+    Diagnostic,
+    apply_suppressions,
+    dotted_chain as _dotted,
+    make,
+)
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -419,14 +447,461 @@ def audit_tpe_run(n_trials: int = 200, seed: int = 0, space=None,
 
 
 # ---------------------------------------------------------------------
+# 4. partition safety (PL206-PL208)
+# ---------------------------------------------------------------------
+
+# function -> minimum number of with_sharding_constraint call sites.
+# The names are load-bearing (PR 11's replicated-pin contract):
+# _build_multi_run pins every family's inputs at program entry;
+# _family_suggest_core pins the candidate draw replicated before laying
+# it over dp and re-pins the scores; _sharded_pair_apply pins z/params
+# at the shard_map boundary and the scores on the way out.
+_PIN_EXPECTATIONS = {
+    "_build_multi_run": 1,
+    "_family_suggest_core": 3,
+    "_sharded_pair_apply": 3,
+}
+
+_DISPATCH_FNS = (
+    "multi_family_suggest",
+    "multi_family_suggest_async",
+    "multi_study_suggest_async",
+)
+
+# ops a value flows through unchanged for pin-adjacency purposes
+_PASSTHROUGH_PRIMS = {
+    "slice", "squeeze", "reshape", "convert_element_type",
+    "broadcast_in_dim", "transpose",
+}
+
+
+def _literal_type():
+    try:
+        from jax.core import Literal
+    except Exception:  # pragma: no cover - jax layout drift
+        from jax._src.core import Literal
+    return Literal
+
+
+def lint_pin_sites(repo_root: str = _REPO_ROOT) -> List[Diagnostic]:
+    """PL206, static backbone: the replicated-pin call sites in
+    ``algos/tpe_device.py`` are present (the live jaxpr audit proves
+    they do what they claim; this check survives refactors that rename
+    or drop them without a mesh in CI)."""
+    rel = os.path.join("algos", "tpe_device.py")
+    path = os.path.join(repo_root, rel)
+    out: List[Diagnostic] = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError) as e:
+        return [make("PL206", rel, f"cannot audit pin sites: {e}",
+                     severity="warning")]
+    found: Dict[str, int] = {}
+    lines: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name in _PIN_EXPECTATIONS:
+            n = sum(
+                1 for sub in ast.walk(node)
+                if isinstance(sub, ast.Attribute)
+                and sub.attr == "with_sharding_constraint"
+            )
+            found[node.name] = n
+            lines[node.name] = node.lineno
+    for name, expected in _PIN_EXPECTATIONS.items():
+        if name not in found:
+            out.append(make(
+                "PL206", rel,
+                f"pin site {name!r} not found; the partition audit's "
+                f"expectation table is stale",
+                severity="warning",
+                hint="update _PIN_EXPECTATIONS in analysis/program_lint.py",
+            ))
+        elif found[name] < expected:
+            out.append(make(
+                "PL206", f"{rel}:{lines[name]}",
+                f"{name} carries {found[name]} "
+                f"with_sharding_constraint pin(s); the mesh contract "
+                f"requires {expected} (replicated pins at entry/draw/"
+                f"pair boundaries)",
+                hint="restore the replicated "
+                     "with_sharding_constraint(PartitionSpec()) pins — "
+                     "without them XLA's SPMD partitioner miscompiles "
+                     "the upstream fit/sample program",
+            ))
+    return out
+
+
+def lint_dispatch_callers(paths=None) -> List[Diagnostic]:
+    """PL208, static: every dispatch call site in the package passes
+    request pytree containers in the normalized TUPLE form.  A request
+    triple built as ``(kind, [a, b], statics)`` — or via ``list(args)``
+    — makes the container type part of the jit pytree key and silently
+    retraces the fused program on every call (the PR 10 class)."""
+    from .durability_lint import package_files
+
+    out: List[Diagnostic] = []
+    for path in paths or package_files():
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        except (OSError, SyntaxError):
+            continue
+        # one-level name resolution, per enclosing function; each unit
+        # walks only its OWN statements (nested function bodies are
+        # their own units — walking them from the parent too would
+        # duplicate every diagnostic)
+        def unit_nodes(unit):
+            out = []
+            stack = list(unit.body)
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(node, ast.ClassDef):
+                    stack.extend(node.body)
+                    continue
+                out.append(node)
+                stack.extend(ast.iter_child_nodes(node))
+            return out
+
+        for fn in [tree] + [
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]:
+            assigns: Dict[str, ast.AST] = {}
+            for stmt in fn.body:
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name):
+                    assigns.setdefault(stmt.targets[0].id, stmt.value)
+            for node in unit_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = _dotted(node.func)
+                if not chain or chain[-1] not in _DISPATCH_FNS:
+                    continue
+                if not node.args:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Name):
+                    arg = assigns.get(arg.id, arg)
+                for sub in ast.walk(arg):
+                    if isinstance(sub, (ast.Tuple, ast.List)) \
+                            and len(sub.elts) == 3:
+                        args_elt = sub.elts[1]
+                        if isinstance(args_elt, ast.Name):
+                            # args built in a local first (the PR 10
+                            # replay shape): resolve one level
+                            args_elt = assigns.get(args_elt.id, args_elt)
+                        bad = isinstance(args_elt, ast.List) or (
+                            isinstance(args_elt, ast.Call)
+                            and isinstance(args_elt.func, ast.Name)
+                            and args_elt.func.id == "list"
+                        )
+                        if bad:
+                            out.append(make(
+                                "PL208", f"{path}:{sub.lineno}",
+                                f"request passed to {chain[-1]} carries "
+                                f"its args in a list: the container "
+                                f"type is part of the jit pytree key, "
+                                f"so this call site retraces the fused "
+                                f"program every time",
+                                hint="build the args element as a tuple "
+                                     "(the dispatch normalizes "
+                                     "defensively, but the contract is "
+                                     "tuples at every call site)",
+                            ))
+    return out
+
+
+def virtual_mesh(max_devices: int = 8):
+    """A dp×sp mesh over up to 8 local devices (the virtual 8-device
+    CPU mesh in CI — ``--xla_force_host_platform_device_count=8``);
+    None when fewer than 2 devices are available (nothing to audit)."""
+    import jax
+
+    from ..parallel.sharding import default_mesh
+
+    devs = list(jax.devices())[:max_devices]
+    n = len(devs)
+    if n < 2:
+        return None
+    sp = 2 if n % 2 == 0 and n >= 4 else 1
+    return default_mesh(shape=(n // sp, sp), devices=devs)
+
+
+def scan_partition_jaxpr(closed_jaxpr, location: str) -> List[Diagnostic]:
+    """PL206/PL207 over one traced fused program (jaxpr level).
+
+    PL206 — the replicated-pin contract, three structural checks:
+
+    1. every top-level program input is FIRST consumed by a
+       fully-replicated ``sharding_constraint`` (the entry pins);
+    2. every ``shard_map``'s array operands are produced by replicated
+       constraints, and its outputs feed (through shape-preserving ops)
+       into a replicated constraint (both sides of the sharded pair
+       scorer are pinned);
+    3. every non-replicated constraint's input comes from a replicated
+       constraint (the draw's rep-then-dp two-step).
+
+    PL207 — a forward taint walk: values downstream of a non-replicated
+    constraint (not yet re-pinned) must never reach a ``concatenate``
+    whose operands differ in size along the concat axis (the
+    ``pair_params`` Kb+Ka class the SPMD partitioner splits
+    inconsistently)."""
+    Literal = _literal_type()
+    out: List[Diagnostic] = []
+    top = closed_jaxpr.jaxpr
+
+    # -- check 1: entry pins -------------------------------------------
+    invar_ids = {id(v): i for i, v in enumerate(top.invars)}
+    first_consumer: Dict[int, object] = {}
+    for eqn in top.eqns:
+        for iv in eqn.invars:
+            if isinstance(iv, Literal):
+                continue
+            j = invar_ids.get(id(iv))
+            if j is not None and j not in first_consumer:
+                first_consumer[j] = eqn
+    unpinned = []
+    for j, eqn in first_consumer.items():
+        s = eqn.params.get("sharding")
+        if eqn.primitive.name != "sharding_constraint" or s is None \
+                or not s.is_fully_replicated:
+            unpinned.append(j)
+    if unpinned:
+        out.append(make(
+            "PL206", location,
+            f"{len(unpinned)} of {len(top.invars)} program input(s) "
+            f"(indices {unpinned[:8]}{'...' if len(unpinned) > 8 else ''}) "
+            f"are not first consumed by a replicated sharding "
+            f"constraint: the entry pins are missing or bypassed",
+            hint="pin every family's inputs replicated at program entry "
+                 "(see tpe_device._build_multi_run)",
+        ))
+
+    # -- checks 2+3, per (sub-)jaxpr ------------------------------------
+    def walk_structural(jx):
+        producer = {}
+        consumers: Dict[int, List] = {}
+        for eqn in jx.eqns:
+            for ov in eqn.outvars:
+                producer[id(ov)] = eqn
+            for iv in eqn.invars:
+                if not isinstance(iv, Literal):
+                    consumers.setdefault(id(iv), []).append(eqn)
+
+        def produced_by_replicated_pin(var):
+            p = producer.get(id(var))
+            return (
+                p is not None
+                and p.primitive.name == "sharding_constraint"
+                and p.params["sharding"].is_fully_replicated
+            )
+
+        def terminal_consumers(var, depth=0):
+            outs = []
+            if depth > 8:
+                return outs
+            for eqn in consumers.get(id(var), ()):
+                if eqn.primitive.name in _PASSTHROUGH_PRIMS:
+                    for ov in eqn.outvars:
+                        outs.extend(terminal_consumers(ov, depth + 1))
+                else:
+                    outs.append(eqn)
+            return outs
+
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            if name == "shard_map":
+                for iv in eqn.invars:
+                    if isinstance(iv, Literal):
+                        continue
+                    aval = getattr(iv, "aval", None)
+                    if aval is None or not getattr(aval, "shape", ()):
+                        continue  # scalars (k_below) need no pin
+                    if not produced_by_replicated_pin(iv):
+                        out.append(make(
+                            "PL206", location,
+                            "a shard_map (sharded pair scorer) operand "
+                            "is not pinned replicated at the boundary: "
+                            "the partitioner will back-propagate the "
+                            "in_specs into the upstream fit/sample "
+                            "program",
+                            hint="with_sharding_constraint(x, "
+                                 "NamedSharding(mesh, PartitionSpec())) "
+                                 "on every operand (see "
+                                 "tpe_device._sharded_pair_apply)",
+                        ))
+                for ov in eqn.outvars:
+                    terms = terminal_consumers(ov)
+                    bad = [
+                        t for t in terms
+                        if not (
+                            t.primitive.name == "sharding_constraint"
+                            and t.params["sharding"].is_fully_replicated
+                        )
+                    ]
+                    if terms and bad:
+                        out.append(make(
+                            "PL206", location,
+                            "a shard_map output reaches "
+                            f"'{bad[0].primitive.name}' without being "
+                            "re-pinned replicated: the sharded region "
+                            "is not contained and downstream compiles "
+                            "partitioned",
+                            hint="pin the scores replicated before the "
+                                 "argmax (see "
+                                 "tpe_device._sharded_pair_apply)",
+                        ))
+            elif name == "sharding_constraint" \
+                    and not eqn.params["sharding"].is_fully_replicated:
+                iv = eqn.invars[0]
+                if not isinstance(iv, Literal) \
+                        and not produced_by_replicated_pin(iv):
+                    out.append(make(
+                        "PL206", location,
+                        "a non-replicated sharding constraint (the "
+                        "candidate dp lay-out) is applied to a value "
+                        "that was not first pinned replicated: the "
+                        "candidate sharding can back-propagate into "
+                        "the draw/fit stages",
+                        hint="pin replicated FIRST, then lay out over "
+                             "dp (the rep-then-dp two-step in "
+                             "tpe_device._family_suggest_core)",
+                    ))
+        for eqn in jx.eqns:
+            for v in eqn.params.values():
+                stack = [v]
+                while stack:
+                    item = stack.pop()
+                    if hasattr(item, "jaxpr") and hasattr(item, "consts"):
+                        walk_structural(item.jaxpr)
+                    elif hasattr(item, "eqns"):
+                        walk_structural(item)
+                    elif isinstance(item, (tuple, list)):
+                        stack.extend(item)
+
+    walk_structural(top)
+
+    # -- PL207 taint walk ----------------------------------------------
+    taint: Dict[int, bool] = {}
+
+    def walk_taint(jx):
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            in_taint = any(
+                taint.get(id(v), False)
+                for v in eqn.invars if not isinstance(v, Literal)
+            )
+            if name == "sharding_constraint":
+                t = not eqn.params["sharding"].is_fully_replicated
+                for ov in eqn.outvars:
+                    taint[id(ov)] = t
+                continue
+            if name == "concatenate" and in_taint:
+                dim = eqn.params.get("dimension", 0)
+                sizes = {
+                    v.aval.shape[dim] for v in eqn.invars
+                    if hasattr(v, "aval") and len(v.aval.shape) > dim
+                }
+                # only a tainted operand EXTENDED along the concat axis
+                # can be split by the partitioner there; a size-1
+                # operand (e.g. the gathered EI winner riding into the
+                # flat output assembly) is replicated along that axis
+                # by construction and is not the pair_params class
+                tainted_big = any(
+                    taint.get(id(v), False)
+                    and hasattr(v, "aval") and len(v.aval.shape) > dim
+                    and v.aval.shape[dim] > 1
+                    for v in eqn.invars if not isinstance(v, Literal)
+                )
+                if len(sizes) > 1 and tainted_big:
+                    out.append(make(
+                        "PL207", location,
+                        f"a sharded (non-replicated) value reaches an "
+                        f"unequal-size concatenate (operand sizes "
+                        f"{sorted(sizes)} along axis {dim}): the SPMD "
+                        f"partitioner splits the unequal operands "
+                        f"inconsistently and the scores silently "
+                        f"diverge from the single-chip program",
+                        hint="re-pin the value replicated before the "
+                             "concat, or move the concat above the "
+                             "sharded region",
+                    ))
+            sub = eqn.params.get("jaxpr")
+            if name == "pjit" and sub is not None \
+                    and hasattr(sub, "jaxpr"):
+                inner = sub.jaxpr
+                for ov_outer, iv_inner in zip(eqn.invars, inner.invars):
+                    if not isinstance(ov_outer, Literal):
+                        taint[id(iv_inner)] = taint.get(id(ov_outer), False)
+                walk_taint(inner)
+                for ov_outer, ov_inner in zip(eqn.outvars, inner.outvars):
+                    taint[id(ov_outer)] = (
+                        not isinstance(ov_inner, Literal)
+                        and taint.get(id(ov_inner), False)
+                    )
+                continue
+            for ov in eqn.outvars:
+                taint[id(ov)] = in_taint
+
+    walk_taint(top)
+    return out
+
+
+def lint_partition_program(requests=None, mesh=None,
+                           suppress=()) -> List[Diagnostic]:
+    """Trace the LIVE fused suggest program under a (virtual) device
+    mesh and verify the PL206/PL207 partition contract at the jaxpr
+    level.  Tracing only — nothing executes on the devices, so the
+    8-device CPU mesh in CI audits the exact program a TPU slice would
+    run.  Returns [] (with a log note) when fewer than 2 devices are
+    visible — run under the forced-8-device ``XLA_FLAGS``."""
+    import logging
+
+    from ..algos import tpe_device
+
+    if mesh is None:
+        mesh = virtual_mesh()
+    if mesh is None:
+        logging.getLogger(__name__).warning(
+            "partition audit skipped: fewer than 2 devices visible "
+            "(set XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+        )
+        return []
+    if requests is None:
+        requests = capture_requests()
+    meshed = [
+        (kind, args, dict(st, mesh=mesh) if kind == "cont" else st)
+        for kind, args, st in requests
+    ]
+    closed = tpe_device.multi_family_jaxpr(meshed)
+    names = [n for n in getattr(mesh, "axis_names", ())]
+    shape = "x".join(str(int(mesh.shape[n])) for n in names)
+    loc = f"tpe_device.multi_family_suggest[mesh {shape}]"
+    return apply_suppressions(scan_partition_jaxpr(closed, loc), suppress)
+
+
+# ---------------------------------------------------------------------
 # entry point
 # ---------------------------------------------------------------------
 
 
-def lint_programs(static_only: bool = False, suppress=()) -> List[Diagnostic]:
-    """All program checks.  ``static_only`` skips the jaxpr trace (no
-    jax import, sub-second — the CI fast path)."""
+def lint_programs(static_only: bool = False, suppress=(),
+                  paths=None) -> List[Diagnostic]:
+    """All program checks.  ``static_only`` skips the jaxpr traces (no
+    jax import, sub-second — the CI fast path); the static tier still
+    covers the donation contract, the partition pin sites, and the
+    dispatch-container call sites.  ``paths`` feeds an already-
+    discovered package file list to the dispatch-caller scan."""
     out = lint_donation()
+    out.extend(lint_pin_sites())
+    out.extend(lint_dispatch_callers(paths))
     if not static_only:
-        out.extend(lint_traced_program())
+        requests = capture_requests()
+        out.extend(lint_traced_program(requests))
+        out.extend(lint_partition_program(requests))
     return apply_suppressions(out, suppress)

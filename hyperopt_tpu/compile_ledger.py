@@ -397,7 +397,10 @@ class CompileLedger:
                 )
                 try:
                     os.write(fd, line)
-                    os.fsync(fd)
+                    # the ledger lock deliberately serializes journal
+                    # I/O: appends must land in seq order and must not
+                    # interleave with the compaction rewrite below
+                    os.fsync(fd)  # lint: disable=RL305
                 finally:
                     os.close(fd)
                 self._appends_since_compact += 1

@@ -275,9 +275,12 @@ def build_corpus(domains, seeds, checkpoints, n_configs, cont_evals, log=print):
 def save_rows(rows, path):
     """Pickle one corpus shard (list of (features, labels) rows) — lets
     the hours-long sweep run as independent per-domain processes and
-    survive interruptions; merge with ``--fit-from``."""
-    with open(path, "wb") as f:
-        pickle.dump(rows, f)
+    survive interruptions; merge with ``--fit-from``.  Atomic replace:
+    an interruption mid-save keeps the previous shard intact instead of
+    tearing hours of sweep output."""
+    from ..checkpoint import atomic_pickle_dump
+
+    atomic_pickle_dump(rows, path)
 
 
 def load_rows(paths):
@@ -448,12 +451,20 @@ def _held_out_regret(models, scaling, seeds=(0, 1, 2), max_evals=40, log=print):
 
 
 def write_artifacts(models, scaling, out_dir):
+    # atomic replaces: a sweep interrupted mid-write must never leave a
+    # torn artifact that the ATPE suggest path would then unpickle
+    from ..parallel.file_trials import _atomic_write
+
     os.makedirs(out_dir, exist_ok=True)
-    with open(os.path.join(out_dir, "scaling_model.json"), "w") as f:
-        json.dump(scaling, f, indent=1, sort_keys=True)
+    _atomic_write(
+        os.path.join(out_dir, "scaling_model.json"),
+        json.dumps(scaling, indent=1, sort_keys=True).encode(),
+    )
     for target, model in models.items():
-        with open(os.path.join(out_dir, f"model-{target}.pkl"), "wb") as f:
-            pickle.dump(model, f)
+        _atomic_write(
+            os.path.join(out_dir, f"model-{target}.pkl"),
+            pickle.dumps(model),
+        )
 
 
 def _fit_validate_write(rows, out):

@@ -59,8 +59,10 @@ server's HTTP edge and its crash-consistent store:
   handler thread per socket.
 
 Every service-plane injection can be appended to a crash-surviving
-``injection_log`` (JSONL, ``O_APPEND``) so a campaign can reconcile
-injected-fault counts across server kills.
+``injection_log`` (``O_APPEND``, CRC-framed records via
+``tracing.format_record`` — the same journal discipline as the response
+journal and trace log) so a campaign can reconcile injected-fault
+counts across server kills.
 
 Activate with :func:`active` (a context manager setting the process-wide
 monkey); the production code paths cost one ``sys.modules`` lookup when
@@ -79,11 +81,12 @@ import logging
 import os
 import threading
 import time
+import zlib
 from collections import defaultdict
 from dataclasses import dataclass
 
 from ..observability import FaultStats
-from ..tracing import current_trace_id
+from ..tracing import current_trace_id, format_record
 from .device import SyntheticDeviceError
 
 logger = logging.getLogger(__name__)
@@ -154,6 +157,36 @@ class ChaosConfig:
         return cls(**known)
 
 
+def parse_injection_log(raw: bytes) -> list:
+    """Records from raw injection-log bytes.
+
+    Records are CRC-framed (``tracing.format_record``: ``\\n<crc32 hex>
+    <json>``); bare-JSON lines written by pre-framing versions of this
+    module are still accepted, so an upgraded server replays its old
+    log.  Torn lines (a SIGKILL mid-append) are skipped — the frame
+    makes them detectable rather than silently half-parsed."""
+    records = []
+    for line in raw.split(b"\n"):
+        line = line.strip()
+        if not line:
+            continue
+        rec = None
+        try:
+            crc_hex, body = line.split(b" ", 1)
+            if (zlib.crc32(body) & 0xFFFFFFFF) == int(crc_hex, 16):
+                rec = json.loads(body.decode())
+        except (ValueError, json.JSONDecodeError, UnicodeDecodeError):
+            rec = None
+        if rec is None:
+            try:
+                rec = json.loads(line.decode())  # legacy unframed line
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                continue  # the torn tail of a mid-append SIGKILL
+        if isinstance(rec, dict):
+            records.append(rec)
+    return records
+
+
 def stable_key(cfg) -> str:
     """Deterministic key for an objective's config dict (the same
     suggested point maps to the same key in every run)."""
@@ -202,17 +235,12 @@ class ChaosMonkey:
         except OSError:
             return
         with self._roll_lock:
-            for line in raw.split(b"\n"):
-                line = line.strip()
-                if not line:
-                    continue
+            for rec in parse_injection_log(raw):
                 try:
-                    rec = json.loads(line.decode())
                     site, key = rec["site"], rec["key"]
                     occ = int(rec["occurrence"])
-                except (json.JSONDecodeError, UnicodeDecodeError,
-                        KeyError, TypeError, ValueError):
-                    continue  # the torn tail of a mid-append SIGKILL
+                except (KeyError, TypeError, ValueError):
+                    continue
                 if self._occurrence[(site, key)] <= occ:
                     self._occurrence[(site, key)] = occ + 1
 
@@ -238,8 +266,9 @@ class ChaosMonkey:
 
     def _log_injection(self, site, key, occ):
         """Append one injection record to the crash-surviving log.
-        ``O_APPEND`` single-write: a SIGKILL mid-append tears at most
-        the final line, which the reader tolerates.
+        One CRC-framed ``O_APPEND`` write (``tracing.format_record``):
+        a SIGKILL mid-append tears at most the final record, and the
+        frame makes the tear detectable instead of a parse guess.
 
         The active request-trace id (if the injecting thread is inside
         a traced request) is stamped into the record, so a fault in a
@@ -253,14 +282,14 @@ class ChaosMonkey:
         self._recent.append(record)
         if not self.config.injection_log:
             return
-        line = json.dumps(record, sort_keys=True) + "\n"
+        line = format_record(record)
         try:
             fd = os.open(
                 self.config.injection_log,
                 os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644,
             )
             try:
-                os.write(fd, line.encode())
+                os.write(fd, line)
             finally:
                 os.close(fd)
         except OSError:

@@ -268,6 +268,7 @@ def fsck_queue(qdir, repair=False, report: FsckReport = None) -> FsckReport:
 
                     try:
                         doc, draw = restored
+                        # durability: exempt(offline repair: fsck runs single-writer against a stopped queue)
                         _write_doc(path, doc)
                         docs_by_tid[int(doc["tid"])] = doc
                         seen_states[int(doc["tid"])] = doc["state"]
@@ -280,6 +281,7 @@ def fsck_queue(qdir, repair=False, report: FsckReport = None) -> FsckReport:
                                 if result.get("status") == STATUS_FAIL
                                 else JOB_STATE_DONE
                             )
+                            # durability: exempt(offline repair: fsck runs single-writer against a stopped queue)
                             _write_doc(path, doc)
                             seen_states[int(doc["tid"])] = doc["state"]
                         action += "; restored from response journal"
@@ -411,6 +413,7 @@ def fsck_queue(qdir, repair=False, report: FsckReport = None) -> FsckReport:
                 from ..parallel.file_trials import _atomic_write
 
                 try:
+                    # durability: exempt(offline repair: fsck runs single-writer against a stopped queue)
                     _atomic_write(counter_file, str(max_tid + 1).encode())
                     fixed = True
                 except OSError:
@@ -442,6 +445,7 @@ def fsck_queue(qdir, repair=False, report: FsckReport = None) -> FsckReport:
                 from ..parallel.file_trials import _atomic_write
 
                 try:
+                    # durability: exempt(offline repair: fsck runs single-writer against a stopped queue)
                     _atomic_write(cursor_file, str(evidenced).encode())
                     fixed = True
                 except OSError:

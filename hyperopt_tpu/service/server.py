@@ -334,7 +334,7 @@ class ServiceServer:
         self.host, self.port = self.httpd.server_address[:2]
         self._thread = None
         self._stop_lock = threading.Lock()
-        self._stopped = False
+        self._stopped = False  # guarded-by: _stop_lock
 
     @property
     def url(self):

@@ -131,6 +131,7 @@ def temp_dir(dir_path, erase_after=False, with_sentinel=True):
         created_by_me = True
     sentinel = os.path.join(dir_path, ".hyperopt_tpu_tmp")
     if with_sentinel:
+        # durability: exempt(ephemeral scratch-dir marker, unlinked on exit)
         with open(sentinel, "w") as f:
             f.write("tmp\n")
     try:

@@ -431,14 +431,11 @@ def _count_injections(path):
             raw = f.read()
     except FileNotFoundError:
         return out
-    for line in raw.split(b"\n"):
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            rec = json.loads(line.decode())
-        except (json.JSONDecodeError, UnicodeDecodeError):
-            continue  # a torn tail line (the server was killed mid-append)
+    from hyperopt_tpu.resilience.chaos import parse_injection_log
+
+    # CRC-framed records; torn tail lines (the server was killed
+    # mid-append) are detected by their frame and skipped
+    for rec in parse_injection_log(raw):
         site = rec.get("site", "?")
         out[site] = out.get(site, 0) + 1
     return out

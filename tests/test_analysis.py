@@ -1,13 +1,20 @@
-"""Tests for hyperopt_tpu.analysis — the three-pass static analyzer.
+"""Tests for hyperopt_tpu.analysis — the four-pass static analyzer.
 
 Structure mirrors the acceptance contract:
 
 - a fixture corpus of deliberately broken spaces/programs/sources with
-  GOLDEN diagnostics (every seeded violation must be caught, by rule id);
+  GOLDEN diagnostics (every seeded violation must be caught, by rule id
+  — and each DL4xx/RL30x/PL20x fixture fires ONLY its intended id);
 - zero-false-positive runs over every ``examples/`` space, the four
-  QUALITY.md benchmark domains, and the repo's own concurrent layers;
+  QUALITY.md benchmark domains, and the whole package (race +
+  durability + program self-lint, zero diagnostics);
 - the recompilation auditor asserting the fused TPE suggest program
   retraces at most once per trial-count bucket over a 200-trial CPU run;
+- regression fixtures re-introducing shipped bugs (the PR 5
+  ids.counter truncate-then-write tear; the PR 10 list-vs-tuple pytree
+  retrace) and asserting the linter catches both;
+- the lock-order graph acceptance gate: every auto-discovered
+  lock-bearing module appears in the graph and every scope is acyclic;
 - the construction-time validation satellites (InvalidSpaceError,
   path-qualified DuplicateLabel, fmin validate_space pre-flight).
 """
@@ -26,10 +33,19 @@ from hyperopt_tpu import Trials, fmin, hp
 from hyperopt_tpu.analysis import (
     RULES,
     Severity,
+    diagnostics_json,
+    discover_race_files,
     lint_donation,
+    lint_durability,
     lint_races,
+    lint_repo,
     lint_source,
     lint_space,
+    lock_order_graph,
+    package_files,
+)
+from hyperopt_tpu.analysis.durability_lint import (
+    lint_source as dl_lint_source,
 )
 from hyperopt_tpu.analysis.diagnostics import (
     format_report,
@@ -40,7 +56,12 @@ from hyperopt_tpu.analysis.program_lint import (
     RecompilationAuditor,
     _request_dtype_diags,
     audit_tpe_run,
+    lint_dispatch_callers,
+    lint_partition_program,
+    lint_pin_sites,
     scan_jaxpr,
+    scan_partition_jaxpr,
+    virtual_mesh,
 )
 from hyperopt_tpu.exceptions import DuplicateLabel, InvalidSpaceError
 from hyperopt_tpu.pyll.base import scope
@@ -346,6 +367,7 @@ def test_race_corpus_golden():
         "RL301",  # bad_closure_leak
         "RL302",  # bad_inversion
         "RL303",  # Stale._missing_lock
+        "RL304",  # good() takes _a then _b; bad_inversion the reverse
     ]
     by_rule = {}
     for d in diags:
@@ -358,7 +380,8 @@ def test_race_corpus_golden():
 
 def test_race_lint_multi_item_with_inversion():
     """`with self._b, self._a:` is the same inversion as the nested
-    form and must be flagged identically."""
+    form and must be flagged identically (and the two opposing
+    acquisition orders are also the RL304 cycle shape)."""
     src = textwrap.dedent(
         """
         import threading
@@ -377,8 +400,67 @@ def test_race_lint_multi_item_with_inversion():
         """
     )
     diags = lint_source(src, "f.py")
-    assert _rules(diags) == ["RL302"]
+    assert _rules(diags) == ["RL302", "RL304"]
+    assert diags[0].rule == "RL302"
     assert diags[0].location.endswith(":10")  # the `with self._b, self._a:`
+
+
+def test_race_lint_module_guard_shadowing():
+    """A function parameter or local that shadows a guarded module
+    global is NOT an access to the global: per Python scoping the name
+    is local everywhere in the function, so module-mode RL301 must
+    stay silent (a `global` declaration restores the check)."""
+    src = textwrap.dedent(
+        """
+        import threading
+        _lock = threading.Lock()
+        _state = None  # guarded-by: _lock
+
+        def shadow_param(_state):
+            return _state
+
+        def shadow_local():
+            _state = 3
+            return _state
+
+        def real_access():
+            global _state
+            _state = 5
+        """
+    )
+    diags = lint_source(src, "m.py")
+    assert _rules(diags) == ["RL301"]
+    assert diags[0].location.endswith(":15")  # only real_access
+
+
+def test_race_lint_function_local_lock():
+    """A lock constructed function-locally still fires RL306 (a
+    lock-factory module cannot dodge the pass; the remedy is an
+    explicit exemption), but it must not become a module lock name —
+    in particular it must not mask RL303 for stale module guards."""
+    factory = textwrap.dedent(
+        """
+        import threading
+        def make():
+            lock = threading.Lock()
+            return lock
+        """
+    )
+    assert _rules(lint_source(factory, "m.py")) == ["RL306"]
+
+    stale = textwrap.dedent(
+        """
+        import threading
+        _lock = threading.Lock()
+        _x = None  # guarded-by: _missing
+
+        def helper():
+            _missing = 1
+            return _missing
+        """
+    )
+    # helper's local `_missing` must not satisfy the stale guard
+    assert "RL303" in _rules(lint_source(stale, "m.py"))
 
 
 def test_race_lint_init_is_exempt():
@@ -402,18 +484,17 @@ def test_race_lint_suppression_comment():
 
 
 def test_repo_concurrent_layers_self_lint_clean():
-    """The satellite gate: pipeline.py / file_trials.py / jax_trials.py
-    carry real guarded-by annotations and comply with them."""
+    """The satellite gate: every auto-discovered lock-bearing module
+    carries real guarded-by annotations and complies with them."""
     diags = lint_races()
     assert diags == [], format_report(diags)
     # non-vacuous: the annotations exist and are parsed
     import ast
 
-    from hyperopt_tpu.analysis import RACE_LINT_FILES
     from hyperopt_tpu.analysis.race_lint import _parse_annotations
 
     n_guards = 0
-    for path in RACE_LINT_FILES:
+    for path in discover_race_files():
         with open(path) as f:
             src = f.read()
         for _cls, spec in _parse_annotations(
@@ -444,14 +525,13 @@ def test_race_lint_catches_seeded_repo_violation():
 
 def test_race_lint_covers_resilience_package():
     """The fault-tolerance layer's locks (reaper counters, device
-    recovery state, chaos occurrence counters) are registered with the
-    race pass: the files are in RACE_LINT_FILES, their annotations
-    parse, and a seeded violation is caught (non-vacuous green)."""
-    from hyperopt_tpu.analysis import RACE_LINT_FILES
-
+    recovery state, chaos occurrence counters) are covered by the race
+    pass: the files are auto-discovered, their annotations parse, and a
+    seeded violation is caught (non-vacuous green)."""
+    race_files = discover_race_files()
     resilience_files = {
         os.path.basename(p)
-        for p in RACE_LINT_FILES
+        for p in race_files
         if os.sep + "resilience" + os.sep in p
     }
     assert {"leases.py", "device.py", "chaos.py"} <= resilience_files
@@ -461,7 +541,7 @@ def test_race_lint_covers_resilience_package():
     from hyperopt_tpu.analysis.race_lint import _parse_annotations
 
     guards_by_file = {}
-    for path in RACE_LINT_FILES:
+    for path in race_files:
         if os.sep + "resilience" + os.sep not in path:
             continue
         with open(path) as f:
@@ -476,7 +556,7 @@ def test_race_lint_covers_resilience_package():
     assert guards_by_file["device.py"] >= 2  # reinit count + cpu flag
     assert guards_by_file["chaos.py"] >= 1  # occurrence counters
     # seeded violation: strip the reaper counter's lock block -> RL301
-    path = next(p for p in RACE_LINT_FILES if p.endswith("leases.py"))
+    path = next(p for p in race_files if p.endswith("leases.py"))
     with open(path) as f:
         src = f.read()
     mutated = src.replace(
@@ -586,14 +666,16 @@ def test_cli_race_pass_exit_code(tmp_path):
         [sys.executable, "-m", "hyperopt_tpu.analysis", "race", str(bad)],
         capture_output=True, text=True, cwd=_REPO, env=env, timeout=300,
     )
-    # exit code = error count (5 errors in the fixture)
-    assert proc.returncode == 5, proc.stdout + proc.stderr
+    # exit code = error count (6 errors in the fixture: 4x RL301 +
+    # RL302 + the RL304 cycle; RL303 is a warning)
+    assert proc.returncode == 6, proc.stdout + proc.stderr
     assert "RL301" in proc.stdout and "RL302" in proc.stdout
 
 
-def test_scripts_lint_nonblocking_self_lint():
-    """scripts/lint.py --fast self-lints the repo's own guarded-by
-    annotations + donation contracts and exits 0 (non-blocking step)."""
+def test_scripts_lint_hard_gate_self_lint():
+    """scripts/lint.py --fast self-lints the whole package (race +
+    durability + static program passes) and exits 0 because the repo is
+    clean — the gate is HARD now: a nonzero error count would fail CI."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.run(
         [sys.executable, os.path.join("scripts", "lint.py"), "--fast"],
@@ -601,7 +683,25 @@ def test_scripts_lint_nonblocking_self_lint():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "race pass" in proc.stdout
+    assert "durability pass" in proc.stdout
     assert "0 error(s)" in proc.stdout
+
+
+def test_scripts_lint_no_gate_escape_hatch(tmp_path):
+    """--no-gate is report-only: even with a seeded error the exit code
+    stays 0 (the escape hatch for emergency landings)."""
+    # seed a violation through the module CLI instead of mutating the
+    # repo: a bad file passed to the gated `race` target fails, the
+    # same file under scripts/lint.py --no-gate cannot (scripts/lint.py
+    # lints only the repo, which is clean — assert the flag parses and
+    # exits 0)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join("scripts", "lint.py"), "--fast",
+         "--no-gate"],
+        capture_output=True, text=True, cwd=_REPO, env=env, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
 def test_diagnostic_model_report_shape():
@@ -610,3 +710,664 @@ def test_diagnostic_model_report_shape():
     rep = format_report(diags, header="hdr")
     assert rep.startswith("hdr")
     assert "SP105" in rep and "hint:" in rep
+
+
+# ---------------------------------------------------------------------
+# race_lint v2 (ISSUE 12): RL304 lock cycles, RL305 blocking-under-lock,
+# RL306 unregistered lock modules, auto-discovery
+# ---------------------------------------------------------------------
+
+RL304_FIXTURE = textwrap.dedent(
+    """
+    import threading
+    class C:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self._x = 0  # guarded-by: _a
+        def one(self):
+            with self._a:
+                with self._b:
+                    self._x = 1
+        def two(self):
+            with self._b:
+                with self._a:
+                    self._x = 2
+    """
+)
+
+RL304_CALL_FIXTURE = textwrap.dedent(
+    """
+    import threading
+    class C:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self._x = 0  # guarded-by: _a
+        def helper(self):
+            with self._b:
+                pass
+        def one(self):
+            with self._a:
+                self._x = 1
+                self.helper()
+        def two(self):
+            with self._b:
+                with self._a:
+                    self._x = 2
+    """
+)
+
+RL305_FIXTURE = textwrap.dedent(
+    """
+    import os
+    import threading
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0  # guarded-by: _lock
+        def flush(self, fd):
+            with self._lock:
+                self._n += 1
+                os.fsync(fd)
+    """
+)
+
+RL306_FIXTURE = textwrap.dedent(
+    """
+    import threading
+    _cache_lock = threading.Lock()
+    def get():
+        with _cache_lock:
+            return 1
+    """
+)
+
+
+def test_rl304_cycle_fires_only_rl304():
+    """Two opposing nested acquisitions with no declared order: the
+    cycle is found from the observed graph alone."""
+    diags = lint_source(RL304_FIXTURE, "f.py")
+    assert _rules(diags) == ["RL304"]
+    assert "_a" in diags[0].message and "_b" in diags[0].message
+
+
+def test_rl304_cycle_through_method_call():
+    """A same-scope method called under a lock contributes its own
+    acquisitions as graph edges (the deadlock hides in the callee)."""
+    diags = lint_source(RL304_CALL_FIXTURE, "f.py")
+    assert _rules(diags) == ["RL304"]
+
+
+def test_rl305_blocking_call_under_lock_fires_only_rl305():
+    diags = lint_source(RL305_FIXTURE, "f.py")
+    assert _rules(diags) == ["RL305"]
+    assert diags[0].severity == Severity.WARNING
+    assert "fsync" in diags[0].message
+
+
+def test_rl305_suppression_comment():
+    src = RL305_FIXTURE.replace(
+        "os.fsync(fd)", "os.fsync(fd)  # lint: disable=RL305"
+    )
+    assert lint_source(src, "f.py") == []
+
+
+def test_rl305_join_disambiguation():
+    """Thread .join() under a lock is flagged; str.join / os.path.join
+    (iterable/component args) are not."""
+    src = textwrap.dedent(
+        """
+        import os
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._t = None  # guarded-by: _lock
+            def stop(self):
+                with self._lock:
+                    t = self._t
+                    t.join(5.0)
+            def name(self, parts):
+                with self._lock:
+                    self._t = os.path.join("a", "b")
+                    return ", ".join(parts)
+        """
+    )
+    diags = lint_source(src, "f.py")
+    assert _rules(diags) == ["RL305"]
+    assert "join" in diags[0].message
+
+
+def test_rl306_unregistered_lock_module_fires_only_rl306():
+    diags = lint_source(RL306_FIXTURE, "f.py")
+    assert _rules(diags) == ["RL306"]
+    assert diags[0].severity == Severity.ERROR
+
+
+def test_rl306_exempt_list_skips():
+    assert lint_source(RL306_FIXTURE, "f.py", lock_exempt=True) == []
+
+
+def test_rl306_one_annotation_is_enough():
+    """A module whose lock discipline is declared anywhere is not
+    RL306 — the other rules take over from there."""
+    src = RL306_FIXTURE.replace(
+        "_cache_lock = threading.Lock()",
+        "_cache_lock = threading.Lock()\n"
+        "_cache = None  # guarded-by: _cache_lock",
+    )
+    assert lint_source(src, "f.py") == []
+
+
+def test_module_level_guard_enforced():
+    """The module-global guarded-by form is checked against bare
+    ``with _lock:`` blocks in every function of the module."""
+    src = textwrap.dedent(
+        """
+        import threading
+        _lock = threading.Lock()
+        _state = None  # guarded-by: _lock
+        def good():
+            with _lock:
+                return _state
+        def bad():
+            return _state
+        """
+    )
+    diags = lint_source(src, "f.py")
+    assert _rules(diags) == ["RL301"]
+    assert "_state" in diags[0].message
+
+
+def test_annotation_grammar_in_docstring_is_not_parsed():
+    """Docstring prose quoting the annotation grammar (as race_lint's
+    own module docstring does) must not register phantom guards."""
+    src = textwrap.dedent(
+        '''
+        import threading
+        """Example: ``_lib = None  # guarded-by: _lock`` or a standalone
+        # guarded-by: trials._dynamic_trials: _mutate_lock
+        comment, with # lock-order: _a < _b declaring order."""
+        _real_lock = threading.Lock()
+        _real = 0  # guarded-by: _real_lock
+        def f():
+            with _real_lock:
+                return _real
+        '''
+    )
+    assert lint_source(src, "f.py") == []
+
+
+def test_discover_race_files_covers_old_registry_and_new_sites():
+    """Auto-discovery supersedes the PR 2 hand-maintained file tuple:
+    every module the old registry named is discovered, plus the
+    lock-bearing modules the registry never knew about (the RL306 gap
+    this PR closes: native.py, service/server.py)."""
+    basenames = {os.path.basename(p) for p in discover_race_files()}
+    old_registry = {
+        "pipeline.py", "file_trials.py", "jax_trials.py", "leases.py",
+        "device.py", "chaos.py", "retry.py", "core.py", "client.py",
+        "tracing.py", "slo.py", "profiling.py", "diagnostics.py",
+        "compile_ledger.py",
+    }
+    assert old_registry <= basenames
+    # the modules the hand registry MISSED (found by RL306 discovery)
+    assert "native.py" in basenames
+    assert "server.py" in basenames
+
+
+def test_race_lint_exempt_requires_reason():
+    from hyperopt_tpu.analysis import RACE_LINT_EXEMPT
+
+    for rel, reason in RACE_LINT_EXEMPT.items():
+        assert isinstance(reason, str) and len(reason) > 10, rel
+
+
+def test_lock_order_graph_acceptance():
+    """The acceptance gate: the graph covers every auto-discovered
+    lock-bearing module (no survivor of the old hand-registry gap) and
+    every scope is acyclic."""
+    files = discover_race_files()
+    graph = lock_order_graph(files)
+    covered_paths = {scope_.rsplit(":", 1)[0] for scope_ in graph}
+    for path in files:
+        with open(path) as f:
+            src = f.read()
+        if "threading.Lock(" in src or "threading.RLock(" in src \
+                or "threading.Condition(" in src:
+            assert path in covered_paths, f"{path} missing from graph"
+    for scope_, info in graph.items():
+        assert info["cycles"] == [], (scope_, info)
+        assert info["locks"], scope_
+
+
+# ---------------------------------------------------------------------
+# durability_lint (ISSUE 12): DL401-DL405 fixture corpus
+# ---------------------------------------------------------------------
+
+DUR_CORPUS = [
+    # (name, source, expected rule ids (sorted))
+    ("truncate_live_path", """
+        def save(path, data):
+            with open(path, "w") as f:
+                f.write(data)
+     """, ["DL401"]),
+    ("os_open_trunc_live_path", """
+        import os
+        def save(path, data):
+            fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_TRUNC)
+            try:
+                os.write(fd, data)
+            finally:
+                os.close(fd)
+     """, ["DL401"]),
+    ("replace_without_fsync", """
+        import os
+        def save(path, data):
+            tmp = path + ".tmp.1"
+            with open(tmp, "w") as f:
+                f.write(data)
+            os.replace(tmp, path)
+     """, ["DL402"]),
+    ("atomic_replace_clean", """
+        import os
+        def save(path, data):
+            tmp = path + ".tmp.1"
+            with open(tmp, "w") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+     """, []),
+    ("unframed_append", """
+        import json
+        import os
+        def append(path, rec):
+            line = (json.dumps(rec) + "\\n").encode()
+            fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_APPEND)
+            try:
+                os.write(fd, line)
+            finally:
+                os.close(fd)
+     """, ["DL403"]),
+    ("multi_write_append", """
+        import os
+        import zlib
+        def append(path, body):
+            frame = b"%08x " % zlib.crc32(body)
+            fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_APPEND)
+            try:
+                os.write(fd, frame)
+                os.write(fd, body)
+            finally:
+                os.close(fd)
+     """, ["DL403"]),
+    ("framed_single_write_append_clean", """
+        import os
+        from hyperopt_tpu.tracing import format_record
+        def append(path, rec):
+            line = format_record(rec)
+            fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_APPEND)
+            try:
+                os.write(fd, line)
+            finally:
+                os.close(fd)
+     """, []),
+    ("dangling_tmp", """
+        def stage(path, data):
+            tmp = path + ".tmp.stage"
+            with open(tmp, "w") as f:
+                f.write(data)
+     """, ["DL404"]),
+    ("unlocked_read_modify_write", """
+        def bump(path):
+            with open(path) as f:
+                n = int(f.read() or 0)
+            _atomic_write(path, str(n + 1).encode())
+     """, ["DL405"]),
+    ("locked_read_modify_write_clean", """
+        def bump(path, lock):
+            with lock:
+                with open(path) as f:
+                    n = int(f.read() or 0)
+                _atomic_write(path, str(n + 1).encode())
+     """, []),
+    # a lock held elsewhere in the function does NOT cover an RMW that
+    # sits outside its `with` span
+    ("lock_not_covering_rmw", """
+        def bump(path, lock, data):
+            with lock:
+                pass
+            with open(path) as f:
+                n = int(f.read() or 0)
+            _atomic_write(path, str(n + 1).encode())
+     """, ["DL405"]),
+    # fsync on a DIFFERENT handle between open and replace does not
+    # make the unsynced tmp durable
+    ("fsync_wrong_handle", """
+        import os
+        def publish(path, data):
+            a_tmp = path + ".tmp.a"
+            b_tmp = path + ".tmp.b"
+            with open(a_tmp, "w") as fa:
+                fa.write(data)
+            with open(b_tmp, "w") as fb:
+                fb.write(data)
+                fb.flush()
+                os.fsync(fb.fileno())
+            os.replace(a_tmp, path)
+            os.replace(b_tmp, path + ".bak")
+     """, ["DL402"]),
+    ("excl_lockfile_idiom_clean", """
+        import os
+        def acquire(path):
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, b"owner")
+            os.close(fd)
+     """, []),
+]
+
+
+@pytest.mark.parametrize(
+    "name,source,expected", DUR_CORPUS, ids=[c[0] for c in DUR_CORPUS]
+)
+def test_durability_corpus_golden(name, source, expected):
+    diags = dl_lint_source(textwrap.dedent(source), f"{name}.py")
+    assert _rules(diags) == expected, format_report(diags)
+
+
+def test_durability_exemption_inline():
+    src = textwrap.dedent("""
+        def save(path, data):
+            with open(path, "w") as f:  # durability: exempt(report output, regenerable)
+                f.write(data)
+    """)
+    assert dl_lint_source(src, "f.py") == []
+
+
+def test_durability_exemption_line_above():
+    src = textwrap.dedent("""
+        def save(path, data):
+            # durability: exempt(scratch sentinel, unlinked on exit)
+            with open(path, "w") as f:
+                f.write(data)
+    """)
+    assert dl_lint_source(src, "f.py") == []
+
+
+def test_durability_exemption_on_def():
+    src = textwrap.dedent("""
+        def save(path, data):  # durability: exempt(plot output)
+            with open(path, "w") as f:
+                f.write(data)
+    """)
+    assert dl_lint_source(src, "f.py") == []
+
+
+def test_durability_exemption_requires_reason():
+    """``exempt()`` with an empty reason does not exempt."""
+    src = textwrap.dedent("""
+        def save(path, data):
+            with open(path, "w") as f:  # durability: exempt( )
+                f.write(data)
+    """)
+    assert _rules(dl_lint_source(src, "f.py")) == ["DL401"]
+
+
+def test_durability_regression_pr5_counter_tear():
+    """The shipped PR 5 bug in fixture form: ids.counter was read, then
+    rewritten with a truncating open — a SIGKILL between truncate and
+    write left it empty and restarted trial ids at 0.  The linter must
+    catch the truncate (DL401); the lock-free read-modify-write (DL405)
+    is the same site's second real hazard."""
+    src = textwrap.dedent("""
+        def new_trial_ids(counter_file, n):
+            with open(counter_file) as f:
+                start = int(f.read() or 0)
+            with open(counter_file, "w") as f:
+                f.write(str(start + n))
+            return list(range(start, start + n))
+    """)
+    rules = _rules(dl_lint_source(src, "file_trials_fixture.py"))
+    assert "DL401" in rules
+    assert rules == ["DL401", "DL405"]
+
+
+def test_durability_repo_self_lint_zero():
+    """The shipped self-lint is zero-diagnostic: every durable-write
+    site in the package follows the discipline or carries an explicit
+    reasoned exemption."""
+    diags = lint_durability()
+    assert diags == [], format_report(diags)
+    # non-vacuous: the discovery surface is the whole package
+    assert len(package_files()) > 50
+
+
+# ---------------------------------------------------------------------
+# partition safety (ISSUE 12): PL206-PL208
+# ---------------------------------------------------------------------
+
+
+def _mesh_or_skip():
+    mesh = virtual_mesh()
+    if mesh is None:
+        pytest.skip("needs >=2 devices (XLA_FLAGS device-count force)")
+    return mesh
+
+
+def test_pl206_missing_entry_pin_fires_only_pl206():
+    import jax
+    import jax.numpy as jnp
+
+    _mesh_or_skip()
+
+    def bad_entry(x):
+        return x + 1.0
+
+    closed = jax.make_jaxpr(bad_entry)(jnp.zeros(8, jnp.float32))
+    diags = scan_partition_jaxpr(closed, "fixture")
+    assert _rules(diags) == ["PL206"]
+    assert "entry pins" in diags[0].message
+
+
+def test_pl206_pinned_entry_clean():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = _mesh_or_skip()
+
+    def good_entry(x):
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, PartitionSpec())
+        )
+        return x + 1.0
+
+    closed = jax.make_jaxpr(good_entry)(jnp.zeros(8, jnp.float32))
+    assert scan_partition_jaxpr(closed, "fixture") == []
+
+
+def test_pl207_sharded_unequal_concat_fires_only_pl207():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = _mesh_or_skip()
+    rep = NamedSharding(mesh, PartitionSpec())
+    dp = NamedSharding(mesh, PartitionSpec("dp"))
+
+    def bad_concat(x, y):
+        x = jax.lax.with_sharding_constraint(x, rep)
+        y = jax.lax.with_sharding_constraint(y, rep)
+        xs = jax.lax.with_sharding_constraint(x, dp)
+        return jnp.concatenate([y, xs], axis=0)
+
+    closed = jax.make_jaxpr(bad_concat)(
+        jnp.zeros(8, jnp.float32), jnp.zeros(1, jnp.float32)
+    )
+    diags = scan_partition_jaxpr(closed, "fixture")
+    assert _rules(diags) == ["PL207"]
+    assert "unequal-size concat" in diags[0].message
+
+
+def test_pl207_repinned_before_concat_clean():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = _mesh_or_skip()
+    rep = NamedSharding(mesh, PartitionSpec())
+    dp = NamedSharding(mesh, PartitionSpec("dp"))
+
+    def good_concat(x, y):
+        x = jax.lax.with_sharding_constraint(x, rep)
+        y = jax.lax.with_sharding_constraint(y, rep)
+        xs = jax.lax.with_sharding_constraint(x, dp)
+        xs = jax.lax.with_sharding_constraint(xs, rep)
+        return jnp.concatenate([y, xs], axis=0)
+
+    closed = jax.make_jaxpr(good_concat)(
+        jnp.zeros(8, jnp.float32), jnp.zeros(1, jnp.float32)
+    )
+    assert scan_partition_jaxpr(closed, "fixture") == []
+
+
+def test_pl206_pin_sites_static_seeded_violation(tmp_path):
+    """A tpe_device.py whose pin sites lost their constraints is flagged
+    without tracing anything (the refactor-guard tier of PL206)."""
+    algos = tmp_path / "algos"
+    algos.mkdir()
+    (algos / "tpe_device.py").write_text(textwrap.dedent("""
+        import jax
+        def _build_multi_run():
+            pass
+        def _family_suggest_core():
+            jax.lax.with_sharding_constraint(1, 2)
+        def _sharded_pair_apply():
+            jax.lax.with_sharding_constraint(1, 2)
+    """))
+    diags = lint_pin_sites(repo_root=str(tmp_path))
+    assert _rules(diags) == ["PL206", "PL206", "PL206"]
+
+
+def test_pl206_pin_sites_repo_clean():
+    assert lint_pin_sites() == []
+
+
+def test_pl208_list_container_fires_only_pl208(tmp_path):
+    bad = tmp_path / "caller.py"
+    bad.write_text(textwrap.dedent("""
+        def caller(dev, ids, seed, statics):
+            requests = [("cont", [ids, seed], statics)]
+            return dev.multi_family_suggest_async(requests)
+    """))
+    diags = lint_dispatch_callers([str(bad)])
+    assert _rules(diags) == ["PL208"]
+
+
+def test_pl208_tuple_container_clean(tmp_path):
+    ok = tmp_path / "caller.py"
+    ok.write_text(textwrap.dedent("""
+        def caller(dev, ids, seed, statics):
+            requests = [("cont", (ids, seed), statics)]
+            return dev.multi_family_suggest_async(requests)
+    """))
+    assert lint_dispatch_callers([str(ok)]) == []
+
+
+def test_pl208_regression_pr10_list_vs_tuple_retrace(tmp_path):
+    """The shipped PR 10 bug in fixture form: compile-ledger replay
+    built its request args as lists while the live dispatch used
+    tuples — the pytree container type is part of the jit cache key,
+    so every replay silently retraced.  The static caller check must
+    catch the list at the dispatch call site."""
+    fixture = tmp_path / "replay_fixture.py"
+    fixture.write_text(textwrap.dedent("""
+        def replay(tpe_device, record, statics):
+            args = [record["ids"], record["seed"]]
+            groups = [("study", [(record["kind"], args, statics)])]
+            return tpe_device.multi_study_suggest_async(groups)
+    """))
+    diags = lint_dispatch_callers([str(fixture)])
+    assert _rules(diags) == ["PL208"]
+    assert "retraces" in diags[0].message
+
+
+def test_pl208_repo_dispatch_callers_clean():
+    assert lint_dispatch_callers() == []
+
+
+def test_partition_audit_live_program_green():
+    """Acceptance: PL206/PL207 run green against the LIVE fused suggest
+    program traced under the virtual 8-device CPU mesh."""
+    _mesh_or_skip()
+    diags = lint_partition_program()
+    assert diags == [], format_report(diags)
+
+
+# ---------------------------------------------------------------------
+# whole-repo self-lint + machine-readable output (ISSUE 12)
+# ---------------------------------------------------------------------
+
+
+def test_repo_self_lint_zero_diagnostics():
+    """Acceptance: the full static self-lint (race + durability +
+    program static tiers) reports zero diagnostics on the repo."""
+    diags = lint_repo(static_only=True)
+    assert diags == [], format_report(diags)
+
+
+def test_diagnostics_json_schema():
+    # file:line location -> line split out as an int
+    race = lint_source(RACE_FIXTURE, "fixture.py")
+    rows = diagnostics_json(race)
+    assert rows, "race fixture must produce diagnostics"
+    for row in rows:
+        assert set(row) == {
+            "rule", "severity", "file", "line", "message", "hint"
+        }
+        assert row["severity"] in ("error", "warning", "info")
+    assert all(isinstance(r["line"], int) for r in rows)
+    assert {r["file"] for r in rows} == {"fixture.py"}
+    # graph-path location (space pass) -> line stays None
+    space_rows = diagnostics_json(
+        lint_space({"x": _raw("x", "uniform", 5.0, 1.0)})
+    )
+    assert space_rows and space_rows[0]["line"] is None
+
+
+def test_cli_all_json_machine_readable():
+    """``python -m hyperopt_tpu.analysis self --json`` emits the stable
+    schema on stdout (the CI consumption path; `all` adds the live
+    trace tier on the same schema)."""
+    import json as _json
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "hyperopt_tpu.analysis", "self", "--json"],
+        capture_output=True, text=True, cwd=_REPO, env=env, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert _json.loads(proc.stdout) == []
+
+
+def test_cli_durability_target_exit_code(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        def save(path, data):
+            with open(path, "w") as f:
+                f.write(data)
+    """))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "hyperopt_tpu.analysis", "durability",
+         str(bad), "--json"],
+        capture_output=True, text=True, cwd=_REPO, env=env, timeout=300,
+    )
+    import json as _json
+
+    rows = _json.loads(proc.stdout)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert [r["rule"] for r in rows] == ["DL401"]
+    assert rows[0]["line"] == 3 and rows[0]["hint"]

@@ -556,8 +556,8 @@ class TestSL607:
 
 
 def test_compile_ledger_registered_for_race_lint():
-    from hyperopt_tpu.analysis import RACE_LINT_FILES, lint_file
+    from hyperopt_tpu.analysis import discover_race_files, lint_file
 
     path = os.path.join(REPO, "hyperopt_tpu", "compile_ledger.py")
-    assert path in RACE_LINT_FILES
+    assert path in discover_race_files()
     assert lint_file(path) == []

@@ -640,8 +640,8 @@ def test_render_prometheus_study_health_shape():
 
 
 def test_diagnostics_registered_in_race_lint():
-    from hyperopt_tpu.analysis import RACE_LINT_FILES, lint_races
+    from hyperopt_tpu.analysis import discover_race_files, lint_races
 
-    paths = [p for p in RACE_LINT_FILES if p.endswith("diagnostics.py")]
+    paths = [p for p in discover_race_files() if p.endswith("diagnostics.py")]
     assert paths, "diagnostics.py must be race-linted"
     assert lint_races(paths=paths) == []

@@ -596,9 +596,9 @@ class TestBenchNullContract:
 
 
 def test_profiling_registered_and_race_clean():
-    from hyperopt_tpu.analysis import RACE_LINT_FILES, lint_races
+    from hyperopt_tpu.analysis import discover_race_files, lint_races
 
-    paths = [p for p in RACE_LINT_FILES if p.endswith("profiling.py")]
+    paths = [p for p in discover_race_files() if p.endswith("profiling.py")]
     assert paths, "profiling.py must be race-linted"
     diags = lint_races(paths=paths)
     assert not diags, [str(d) for d in diags]

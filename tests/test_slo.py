@@ -586,9 +586,9 @@ class TestServiceIntegration:
 
 
 def test_slo_registered_and_race_clean():
-    from hyperopt_tpu.analysis import RACE_LINT_FILES, lint_races
+    from hyperopt_tpu.analysis import discover_race_files, lint_races
 
-    slo_paths = [p for p in RACE_LINT_FILES if p.endswith("slo.py")]
+    slo_paths = [p for p in discover_race_files() if p.endswith("slo.py")]
     assert slo_paths, "slo.py must be race-linted"
     diags = lint_races(paths=slo_paths)
     assert not diags, [str(d) for d in diags]

@@ -667,10 +667,9 @@ class TestChaosCorrelation:
         # outside any trace, the stamp is null — still parseable
         victim.write_bytes(b"y" * 100)
         monkey.maybe_torn_doc(str(victim), 8)
-        lines = [
-            json.loads(ln)
-            for ln in open(inj).read().splitlines() if ln.strip()
-        ]
+        from hyperopt_tpu.resilience.chaos import parse_injection_log
+
+        lines = parse_injection_log(open(inj, "rb").read())
         assert lines[0]["site"] == "torn_doc"
         assert lines[0]["trace_id"] == tr.trace_id
         assert lines[1]["trace_id"] is None
@@ -754,10 +753,11 @@ class TestTraceReport:
 
 
 def test_tracing_registered_and_race_clean():
-    from hyperopt_tpu.analysis import RACE_LINT_FILES, lint_races
+    from hyperopt_tpu.analysis import discover_race_files, lint_races
 
     tracing_paths = [
-        p for p in RACE_LINT_FILES if p.endswith("tracing.py")
+        p for p in discover_race_files()
+        if p.endswith(os.sep + "tracing.py")
     ]
     assert tracing_paths, "tracing.py must be race-linted"
     diags = lint_races(paths=tracing_paths)
