@@ -966,6 +966,60 @@ def device_profile_section(argv):
     return 0 if report["ok"] else 1
 
 
+def failover_section(argv):
+    """``python bench.py --failover [--quick]``: replica-plane warm
+    failover smoke — the seeded failover campaign
+    (scripts/failover_campaign.py) on CPU: two replica server processes
+    share one root, 8 studies (one program bucket each) split across
+    them by the consistent-hash ring, the owning replica is kill -9'd
+    mid-campaign, and the survivor takes every orphaned study over
+    claim → fsck-clean → recover → ledger pre-warm → serve; asserts
+    every takeover ok+fsck_clean, ZERO request-path compiles on the
+    migrated studies' first post-failover suggests (cold-counter delta
+    over a quiescent probe window), zero lost/duplicated trials, and
+    trajectories identical to the fault-free single-replica twin.  A
+    quick run writes a separate file so CI can never clobber the
+    committed full artifact (the PR 7 convention).  Prints ONE JSON
+    line like the other bench sections."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    failover = _import_script("failover_campaign")
+    quick = "--quick" in argv
+    out_path = (
+        "FAILOVER_SERVE.quick.json" if quick else "FAILOVER_SERVE.json"
+    )
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    t0 = time.time()
+    report = failover.run_campaign(quick=quick)
+    failover.write_report(report, out_path)
+    out = {
+        "metric": "failover_smoke",
+        "value": report.get("n_migrated", 0),
+        "unit": "migrated_studies",
+        "ok": report["ok"],
+        "victim": report.get("victim"),
+        "takeovers_ok_and_fsck_clean": report.get(
+            "all_takeovers_ok_and_fsck_clean"
+        ),
+        "cold_suggest_delta": report.get(
+            "cold_suggest_delta_over_probe_window"
+        ),
+        "lost_trials": report.get("integrity", {}).get("lost_trials"),
+        "duplicated_trials": report.get("integrity", {}).get(
+            "duplicated_trials"
+        ),
+        "trajectories_match": report.get(
+            "trajectories_match_fault_free"
+        ),
+        "fsck_clean": report.get("fsck_after_repair", {}).get("clean"),
+        "errors": report["errors"],
+        "artifact": out_path,
+        "elapsed_s": round(time.time() - t0, 2),
+    }
+    print(json.dumps(out))
+    return 0 if report["ok"] else 1
+
+
 def main():
     if "--slo" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--slo"]
@@ -997,6 +1051,9 @@ def main():
     if "--chaos-serve" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--chaos-serve"]
         return chaos_serve_section(argv)
+    if "--failover" in sys.argv[1:]:
+        argv = [a for a in sys.argv[1:] if a != "--failover"]
+        return failover_section(argv)
     if "--chaos" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--chaos"]
         return chaos_section(argv)

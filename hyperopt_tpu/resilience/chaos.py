@@ -58,6 +58,26 @@ server's HTTP edge and its crash-consistent store:
   request forever; the handler's read timeout bounds the damage to one
   handler thread per socket.
 
+Replica-plane fault classes (ISSUE 13), aimed at the multi-replica
+serving topology's ownership leases and routing:
+
+- **owning-replica SIGKILL** — the failover campaign's supervisor rolls
+  ``should_kill_replica`` per progress tick and ``kill -9``s the
+  replica owning the watched studies.  Recovery: lease expiry →
+  fencing-token claim → fsck-clean takeover → compile-ledger pre-warm
+  on a surviving replica; clients ride through on ring failover.
+- **lease-renewal stall** — the replica's heartbeat thread freezes past
+  the lease TTL (``maybe_lease_stall``), modelling a stop-the-world
+  pause: the study is reclaimed while the holder still *thinks* it
+  owns it.  Recovery: the resumed holder's writes are stale-fenced and
+  dropped; its next heartbeat discovers the bumped fence and
+  relinquishes.
+- **asymmetric partition** — ``maybe_client_partition`` opens a window
+  during which the HTTP layer drops every client connection while the
+  replica's store-side heartbeats keep running (client↔replica dead,
+  replica↔store alive).  No failover fires — the lease stays warm —
+  so redirects + client-side ring failover alone must carry traffic.
+
 Every service-plane injection can be appended to a crash-surviving
 ``injection_log`` (``O_APPEND``, CRC-framed records via
 ``tracing.format_record`` — the same journal discipline as the response
@@ -128,6 +148,12 @@ class ChaosConfig:
     p_torn_doc: float = 0.0
     p_torn_journal: float = 0.0
     p_slow_loris: float = 0.0
+    # replica-plane sites (failover campaign, ISSUE 13)
+    p_replica_kill: float = 0.0     # supervisor SIGKILLs the owning replica
+    p_lease_stall: float = 0.0      # heartbeat frozen past the lease TTL
+    lease_stall_seconds: float = 3.0
+    p_client_partition: float = 0.0  # client<->replica dead, replica<->store alive
+    partition_seconds: float = 2.0
     # crash-consistent tears: a REAL torn write only damages data whose
     # fsync never returned — i.e. it happens AT a crash, and the write
     # was never acknowledged downstream.  With this flag (the default)
@@ -204,6 +230,14 @@ class ChaosMonkey:
         self.stats = stats if stats is not None else FaultStats()
         self._roll_lock = threading.Lock()
         self._occurrence = defaultdict(int)  # guarded-by: _roll_lock
+        # open client-partition windows (replica_id -> deadline epoch)
+        self._partition_lock = threading.Lock()
+        self._partition_until = {}  # guarded-by: _partition_lock
+        # replicas whose ONE window already opened (see
+        # maybe_client_partition: at most one window per replica per
+        # monkey, or a p=1.0 campaign would re-open the window on every
+        # request and blackhole the fleet forever)
+        self._partition_opened = set()  # guarded-by: _partition_lock
         self._installed_observer = None
         # bounded ring of the most recent injections (log path or not)
         # — the flight recorder's chaos-correlation evidence; deque
@@ -435,6 +469,71 @@ class ChaosMonkey:
 
     def should_slow_loris(self, key) -> bool:
         return self._roll("slow_loris", key, self.config.p_slow_loris)
+
+    # -- replica-plane sites -------------------------------------------
+    def should_kill_replica(self, replica_id) -> bool:
+        """One supervisor roll of the owning-replica SIGKILL site (the
+        failover campaign rolls per progress tick against the replica
+        that currently OWNS the watched studies and ``kill -9``s it at
+        the hits).  Recovery: lease expiry → fencing claim → fsck-clean
+        takeover → ledger pre-warm on the surviving replica."""
+        return self._roll(
+            "replica_kill", str(replica_id), self.config.p_replica_kill
+        )
+
+    def maybe_lease_stall(self, replica_id) -> float:
+        """Roll the lease-renewal stall site: a hit returns the stall
+        duration (seconds) and the replica's heartbeat thread FREEZES
+        for it — renewals stop with the lease left in place, modelling
+        a stop-the-world-paused holder.  ``lease_stall_seconds`` should
+        exceed the replica lease TTL for the stall to be an observable
+        fault (the study is reclaimed; the stalled holder's resumed
+        writes are stale-fenced and dropped)."""
+        if self._roll(
+            "lease_stall", str(replica_id), self.config.p_lease_stall
+        ):
+            logger.info(
+                "chaos: stalling lease heartbeat of %s for %.2fs",
+                replica_id, self.config.lease_stall_seconds,
+            )
+            return float(self.config.lease_stall_seconds)
+        return 0.0
+
+    def maybe_client_partition(self, replica_id):
+        """Roll the asymmetric-partition site: a hit opens a
+        ``partition_seconds`` window during which the HTTP layer drops
+        EVERY client connection to this replica while its store-side
+        heartbeats keep running (client↔replica dead, replica↔store
+        alive).  No failover fires — the lease stays warm — so the
+        traffic must ride on client-side ring failover + redirects.
+
+        At most ONE window opens per replica per monkey: the site is
+        rolled per request, and a per-request re-roll at p=1.0 would
+        otherwise re-open the window forever and model a permanent
+        outage instead of a partition EVENT.  Re-arm by constructing a
+        fresh monkey (the campaign does, one per scenario)."""
+        rid = str(replica_id)
+        with self._partition_lock:
+            if rid in self._partition_opened:
+                return
+        if self._roll(
+            "client_partition", rid, self.config.p_client_partition,
+        ):
+            until = time.time() + float(self.config.partition_seconds)
+            with self._partition_lock:
+                if rid in self._partition_opened:
+                    return  # lost the race to a concurrent request
+                self._partition_opened.add(rid)
+                self._partition_until[rid] = until
+            logger.info(
+                "chaos: client partition of %s for %.2fs",
+                replica_id, self.config.partition_seconds,
+            )
+
+    def client_partitioned(self, replica_id) -> bool:
+        with self._partition_lock:
+            until = self._partition_until.get(str(replica_id), 0.0)
+        return time.time() < until
 
     # -- device-plane site ---------------------------------------------
     def maybe_device_error(self):

@@ -40,11 +40,30 @@ FS408     broken id allocator: a stuck ``ids.counter.lock`` (allocator
           on disk (the next allocation would re-issue an existing tid).
           Repair: delete the stuck lock / advance the counter past the
           highest tid.
+FS409     replica-plane damage under ``<root>/replicas/``: an orphaned
+          study-ownership lease (no study directory AND not live — a
+          live one is the mid-create window, not damage), an expired
+          lease still naming a dead owner (past one extra TTL of
+          grace, so a briefly-stalled live holder is never fenced by a
+          sibling's startup fsck), a torn lease or replica-registry
+          record (fails its CRC trailer), a stuck ``.claimlock`` (a
+          claimant SIGKILL'd inside the lease critical section; only
+          flagged past an age grace no live claimant can reach), or a
+          garbled fence counter.  Repair: delete orphans/stuck locks,
+          reclaim expired leases (owner cleared, **fence preserved** —
+          deleting the fence would reset tokens and let a stale
+          holder's writes through), quarantine torn records, and
+          rewrite a garbled fence counter past the highest evidenced
+          token.
 ========  ==============================================================
 
 Offline by design: run it on a queue no process is writing (the server
-runs it before starting its scheduler).  Repairs are individually
-crash-safe (atomic rename/replace or unlink).
+runs it before starting its scheduler).  The FS409 replica-plane rules
+are the one exception forced to tolerate liveness: in multi-replica
+mode every replica's STARTUP fsck repairs the shared root while
+siblings serve, so those rules gate on lease liveness and age before
+touching anything.  Repairs are individually crash-safe (atomic
+rename/replace or unlink).
 """
 
 from __future__ import annotations
@@ -52,6 +71,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import time
 from dataclasses import dataclass, field
 
 from ..base import (
@@ -68,6 +88,12 @@ from ..parallel.file_trials import (
 
 # states that can legitimately hold a reservation lock
 _LOCKABLE_STATES = (JOB_STATE_RUNNING,)
+
+# a .claimlock younger than this may be a live peer inside the
+# O_CREAT|O_EXCL critical section (the claim path itself steals locks
+# older than the store TTL; fsck can't know the TTL, so it uses a
+# ceiling no live claimant can reach)
+FS409_CLAIMLOCK_GRACE_S = 60.0
 
 
 @dataclass
@@ -462,25 +488,234 @@ def fsck_queue(qdir, repair=False, report: FsckReport = None) -> FsckReport:
     return report
 
 
+def _fsck_replica_plane(root, repair, report: FsckReport):
+    """FS409: the replica plane under ``<root>/replicas/`` — ownership
+    leases, fence counters, claim locks, and registry records."""
+    leases_dir = os.path.join(root, "replicas", "leases")
+    registry_dir = os.path.join(root, "replicas", "registry")
+    studies_dir = os.path.join(root, "studies")
+    if not (os.path.isdir(leases_dir) or os.path.isdir(registry_dir)):
+        return
+    now = time.time()
+
+    def _has_study(study_id):
+        return os.path.isdir(os.path.join(studies_dir, study_id))
+
+    def _read_lease(study_id):
+        try:
+            with open(
+                os.path.join(leases_dir, f"{study_id}.lease"), "rb"
+            ) as f:
+                return _decode_doc(f.read())
+        except (OSError, DocCorrupt):
+            return None
+
+    def _lease_live(lease):
+        if not lease or not lease.get("owner"):
+            return False
+        try:
+            return float(lease.get("expires_at", 0.0)) > now
+        except (TypeError, ValueError):
+            return False
+
+    def _remove(path, detail, action="deleted"):
+        fixed = False
+        if repair:
+            try:
+                os.unlink(path)
+                fixed = True
+            except OSError:
+                pass
+        report.add("FS409", path, detail, repaired=fixed,
+                   action=action if fixed else "")
+
+    for path in sorted(glob.glob(os.path.join(leases_dir, "*.lease"))):
+        study_id = os.path.basename(path)[: -len(".lease")]
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            continue
+        try:
+            lease = _decode_doc(raw)
+        except DocCorrupt as e:
+            # torn lease: quarantine — safe because the FENCE COUNTER,
+            # not the lease file, carries token monotonicity
+            fixed = False
+            action = ""
+            if repair:
+                try:
+                    dest = quarantine_path(path)
+                    os.replace(path, dest)
+                    fixed = True
+                    action = f"quarantined to {os.path.basename(dest)}"
+                except OSError:
+                    pass
+            report.add(
+                "FS409", path, f"torn replica-ownership lease ({e})",
+                repaired=fixed, action=action,
+            )
+            continue
+        if not _has_study(study_id):
+            if _lease_live(lease):
+                # a LIVE lease with no study dir is the mid-create
+                # window (ownership-before-side-effects claims the
+                # lease before the directory exists) — deleting it
+                # would steal a live creator's ownership and, via the
+                # fence file, reset token monotonicity.  Not damage.
+                continue
+            _remove(
+                path,
+                "orphaned replica-ownership lease (no study directory)",
+            )
+            continue
+        owner = lease.get("owner")
+        try:
+            expires_at = float(lease.get("expires_at", 0.0))
+            expired = expires_at <= now
+        except (TypeError, ValueError):
+            expires_at = 0.0
+            expired = True
+        try:
+            grace = max(
+                expires_at - float(lease.get("granted_at", expires_at)),
+                0.0,
+            )
+        except (TypeError, ValueError):
+            grace = 0.0
+        if owner and expired and now <= expires_at + grace:
+            # within one TTL of expiry the holder may be briefly
+            # stalled, not dead: verify() deliberately treats an
+            # expired-but-unreclaimed lease as still held, and claim()
+            # can already take over without fsck's help.  Clearing the
+            # owner here (e.g. a sibling replica's STARTUP fsck on the
+            # shared root) would spuriously fence a live holder.
+            continue
+        if owner and expired:
+            # expired residue of a dead replica: reclaim — owner
+            # cleared, fence PRESERVED (resetting it would let the
+            # dead owner's buffered writes pass a later verify)
+            fixed = False
+            action = ""
+            if repair:
+                from ..parallel.file_trials import _write_doc
+
+                lease = dict(lease)
+                lease["owner"] = None
+                lease["expires_at"] = 0.0
+                lease["reclaimed_by"] = "fsck"
+                try:
+                    # durability: exempt(offline repair: fsck runs single-writer against a stopped store)
+                    _write_doc(path, lease)
+                    fixed = True
+                    action = (
+                        f"reclaimed (owner {owner!r} cleared, fence "
+                        f"{lease.get('fence')} preserved)"
+                    )
+                except OSError:
+                    pass
+            report.add(
+                "FS409", path,
+                f"expired replica-ownership lease still naming "
+                f"{owner!r}",
+                repaired=fixed, action=action,
+            )
+
+    # fence counters: garbled → rewrite past the highest evidenced
+    # token; orphaned (no study) → delete
+    for path in sorted(glob.glob(os.path.join(leases_dir, "*.fence"))):
+        study_id = os.path.basename(path)[: -len(".fence")]
+        if not _has_study(study_id):
+            if _lease_live(_read_lease(study_id)):
+                continue  # mid-create window (see the lease pass)
+            _remove(path, "orphaned fence counter (no study directory)")
+            continue
+        try:
+            with open(path) as f:
+                int(f.read().strip() or 0)
+            continue  # parseable: fine at any value
+        except ValueError:
+            pass
+        except OSError:
+            continue
+        evidenced = 0
+        lease_file = os.path.join(leases_dir, f"{study_id}.lease")
+        try:
+            with open(lease_file, "rb") as f:
+                evidenced = int(_decode_doc(f.read()).get("fence", 0))
+        except (OSError, DocCorrupt, TypeError, ValueError):
+            pass
+        fixed = False
+        if repair:
+            from ..parallel.file_trials import _atomic_write
+
+            try:
+                # durability: exempt(offline repair: fsck runs single-writer against a stopped store)
+                _atomic_write(path, str(evidenced + 1).encode())
+                fixed = True
+            except OSError:
+                pass
+        report.add(
+            "FS409", path,
+            "garbled fence counter (token monotonicity at risk)",
+            repaired=fixed,
+            action=(f"rewrote as {evidenced + 1}" if fixed else ""),
+        )
+
+    # stuck claim locks: a FRESH lock is a peer inside the O_CREAT |
+    # O_EXCL critical section (this fsck may be a sibling replica's
+    # startup pass against a live shared root) — only a lock old
+    # enough that no live claimant can hold it is damage.  The store
+    # itself steals locks older than its TTL; this grace is the
+    # conservative ceiling for roots fsck can't know the TTL of.
+    for path in sorted(glob.glob(os.path.join(leases_dir, "*.claimlock"))):
+        try:
+            age = now - os.path.getmtime(path)
+        except OSError:
+            continue
+        if age <= FS409_CLAIMLOCK_GRACE_S:
+            continue
+        _remove(
+            path,
+            "stuck lease claim lock (claimant killed mid-claim)",
+        )
+
+    # registry records: torn → delete (regenerated by the replica's
+    # next heartbeat; advisory data, never a safety input)
+    for path in sorted(glob.glob(os.path.join(registry_dir, "*.json"))):
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            continue
+        try:
+            _decode_doc(raw)
+        except DocCorrupt as e:
+            _remove(path, f"torn replica-registry record ({e})")
+
+
 def fsck_service_root(root, repair=False) -> FsckReport:
-    """fsck every study queue under an optimization-service root."""
+    """fsck every study queue under an optimization-service root, plus
+    the replica plane (FS409) when one exists."""
     root = os.path.abspath(root)
     report = FsckReport(root=root, repair=repair)
     studies_dir = os.path.join(root, "studies")
-    if not os.path.isdir(studies_dir):
-        return report
-    for name in sorted(os.listdir(studies_dir)):
-        qdir = os.path.join(studies_dir, name)
-        if os.path.isdir(qdir):
-            fsck_queue(qdir, repair=repair, report=report)
+    if os.path.isdir(studies_dir):
+        for name in sorted(os.listdir(studies_dir)):
+            qdir = os.path.join(studies_dir, name)
+            if os.path.isdir(qdir):
+                fsck_queue(qdir, repair=repair, report=report)
+    _fsck_replica_plane(root, repair, report)
     return report
 
 
 def fsck_path(path, repair=False) -> FsckReport:
-    """fsck a service root (has ``studies/``) or a single queue dir
-    (has ``trials/``) — detected by layout."""
+    """fsck a service root (has ``studies/`` or ``replicas/``) or a
+    single queue dir (has ``trials/``) — detected by layout."""
     path = os.path.abspath(path)
-    if os.path.isdir(os.path.join(path, "studies")):
+    if os.path.isdir(os.path.join(path, "studies")) or os.path.isdir(
+        os.path.join(path, "replicas")
+    ):
         return fsck_service_root(path, repair=repair)
     return fsck_queue(path, repair=repair)
 

@@ -24,6 +24,7 @@ Quick start::
 
 from ..resilience.retry import CircuitOpenError
 from .client import (
+    ReplicaRedirect,
     ServiceClient,
     ServiceClientError,
     ServiceTransportError,
@@ -31,6 +32,7 @@ from .client import (
 )
 from .core import (
     BackpressureError,
+    NotOwner,
     OptimizationService,
     ResponseJournal,
     ServiceDraining,
@@ -43,12 +45,26 @@ from .core import (
     decode_space,
     encode_space,
 )
+from .replicas import (
+    HashRing,
+    OwnershipLost,
+    ReplicaDirectory,
+    ReplicaSet,
+    StudyLeaseStore,
+    read_discovery,
+)
 from .server import ServiceServer, free_port
 
 __all__ = [
     "BackpressureError",
     "CircuitOpenError",
+    "HashRing",
+    "NotOwner",
     "OptimizationService",
+    "OwnershipLost",
+    "ReplicaDirectory",
+    "ReplicaRedirect",
+    "ReplicaSet",
     "ResponseJournal",
     "ServiceClient",
     "ServiceClientError",
@@ -57,6 +73,7 @@ __all__ = [
     "ServiceTransportError",
     "Study",
     "StudyExists",
+    "StudyLeaseStore",
     "StudyNotFound",
     "StudyRegistry",
     "SuggestScheduler",
@@ -65,4 +82,5 @@ __all__ = [
     "encode_space",
     "free_port",
     "parse_retry_after",
+    "read_discovery",
 ]

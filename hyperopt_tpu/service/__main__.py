@@ -151,6 +151,25 @@ def make_parser():
              "is bit-for-bit today's path",
     )
     p.add_argument(
+        "--replica-id", default=None, dest="replica_id",
+        help="multi-replica mode: this server's stable identity.  N "
+             "servers sharing one --root split the studies between "
+             "them via fencing-token ownership leases; a dead "
+             "replica's studies migrate to the survivors after an "
+             "fsck-clean, ledger-pre-warmed takeover.  Requires --root",
+    )
+    p.add_argument(
+        "--advertise-url", default=None, dest="advertise_url",
+        help="URL other replicas' clients are redirected to for "
+             "studies this replica owns (default http://<host>:<port> "
+             "when --port is explicit; required with --port 0)",
+    )
+    p.add_argument(
+        "--replica-ttl", type=float, default=None, dest="replica_ttl",
+        help="study-ownership lease TTL in seconds (default 10); a "
+             "replica silent this long has its studies reclaimed",
+    )
+    p.add_argument(
         "--chaos-config", default=None, dest="chaos_config",
         help="TESTING ONLY: JSON ChaosConfig activating seeded "
              "service-plane fault injection (torn writes, connection "
@@ -216,6 +235,19 @@ def main(argv=None):
         cache_dir = _os.path.join(options.root, "xla_cache")
     elif cache_dir and cache_dir.lower() == "none":
         cache_dir = None
+    advertise_url = options.advertise_url
+    if options.replica_id is not None:
+        if not options.root:
+            logger.error("--replica-id requires --root (a shared store)")
+            return 2
+        if advertise_url is None:
+            if options.port == 0:
+                logger.error(
+                    "--replica-id with --port 0 needs --advertise-url "
+                    "(the redirect target cannot be predicted)"
+                )
+                return 2
+            advertise_url = f"http://{options.host}:{options.port}"
     service = OptimizationService(
         root=options.root,
         batch_window=options.batch_window,
@@ -230,7 +262,15 @@ def main(argv=None):
         cold_fallback=options.cold_fallback,
         compile_ledger_path=options.compile_ledger,
         mesh=options.mesh,
+        replica_id=options.replica_id,
+        advertise_url=advertise_url,
+        replica_ttl=options.replica_ttl,
     )
+    if service.replica_set is not None:
+        logger.info(
+            "replica mode: id=%s advertise=%s ttl=%.1fs",
+            options.replica_id, advertise_url, service.replica_set.ttl,
+        )
     if service.mesh_label != "off":
         logger.info(
             "mesh execution mode: %s over %d local device(s)",

@@ -48,6 +48,7 @@ from ..resilience.retry import (
     backoff_delay,
 )
 from .core import BackpressureError, encode_space
+from .replicas import HashRing, read_discovery
 
 logger = logging.getLogger(__name__)
 
@@ -93,6 +94,21 @@ class ServiceTransportError(Exception):
         self.last_error = last_error
 
 
+class ReplicaRedirect(Exception):
+    """A 307 from a non-owner replica, carrying the owner hint.  Raised
+    out of the transport layer and consumed by the study-routing loop
+    (:meth:`ServiceClient._study_request`) — it only escapes to callers
+    who bypass that loop with raw ``_request`` calls."""
+
+    def __init__(self, owner_url=None, owner_id=None, payload=None):
+        super().__init__(
+            f"redirected to owner {owner_id!r} at {owner_url!r}"
+        )
+        self.owner_url = owner_url
+        self.owner_id = owner_id
+        self.payload = payload or {}
+
+
 # transport-level failures that are safe to retry when the request is
 # idempotent.  HTTPError (a served error response) is caught BEFORE this
 # tuple — the server answering is a transport success.
@@ -100,14 +116,46 @@ _TRANSPORT_ERRORS = (OSError, http.client.HTTPException)
 
 
 class ServiceClient:
-    def __init__(self, base_url, timeout=180.0, retry_timeout=30.0,
+    def __init__(self, base_url=None, timeout=180.0, retry_timeout=30.0,
                  deadline=120.0, max_transport_retries=8,
                  backoff_base=0.05, backoff_multiplier=2.0,
                  backoff_max=2.0, jitter=0.2, retry_seed=0,
                  breaker_threshold=8, breaker_cooldown=1.0,
                  idempotency_prefix=None, use_idempotency_keys=True,
-                 tracer=None, trace_headers=True):
-        self.base_url = base_url.rstrip("/")
+                 tracer=None, trace_headers=True, replicas=None,
+                 discovery=None, failover_transport_retries=1):
+        # replica endpoints: an explicit --replica list, a discovery
+        # source (JSON file or a service root's replica registry), or
+        # just the single base_url.  With >1 endpoint, study routes go
+        # through consistent-hash routing + redirect-follow + ring
+        # failover (_study_request); with 1, behavior is byte-for-byte
+        # the single-server client.
+        urls = []
+        if base_url is not None:
+            urls.append(str(base_url).rstrip("/"))
+        if replicas:
+            urls.extend(str(u).rstrip("/") for u in replicas)
+        if discovery is not None:
+            urls.extend(
+                str(u).rstrip("/") for u in read_discovery(discovery)
+            )
+        # de-duplicate, preserving arrival order (base_url stays the
+        # default endpoint for non-study routes)
+        seen = set()
+        self._urls = [
+            u for u in urls if not (u in seen or seen.add(u))
+        ]
+        if not self._urls:
+            raise ValueError(
+                "ServiceClient needs a base_url, replicas list, or "
+                "discovery source"
+            )
+        self.base_url = self._urls[0]
+        self.ring = HashRing(self._urls) if len(self._urls) > 1 else None
+        # per-call transport-retry budget AGAINST ONE replica while
+        # failing over (the ring loop provides the persistence; a dead
+        # primary must cost milliseconds, not the whole retry budget)
+        self.failover_transport_retries = int(failover_transport_retries)
         self.timeout = float(timeout)
         # total wall-clock budget for retrying 429/503 rejections before
         # surfacing BackpressureError to the caller; 0 disables retries
@@ -126,9 +174,17 @@ class ServiceClient:
             jitter=float(jitter),
             seed=int(retry_seed),
         )
-        self.breaker = CircuitBreaker(
-            threshold=breaker_threshold, cooldown=breaker_cooldown
-        )
+        # circuit breakers are PER ENDPOINT (one per replica URL), not
+        # per client: one dead replica tripping its breaker must not
+        # blackhole calls routed to healthy replicas
+        self._breaker_threshold = int(breaker_threshold)
+        self._breaker_cooldown = float(breaker_cooldown)
+        self._breakers_lock = threading.Lock()
+        self._breakers = {}  # guarded-by: _breakers_lock  (url -> breaker)
+        # study -> last-known owner URL (learned from 307 hints and
+        # successful serves; advisory — corrected by the next redirect)
+        self._owner_lock = threading.Lock()
+        self._owner_cache = {}  # guarded-by: _owner_lock
         self.use_idempotency_keys = bool(use_idempotency_keys)
         # tracing: every call carries an X-Hyperopt-Trace id (ambient
         # when the caller already holds a trace, fresh otherwise) so the
@@ -155,8 +211,41 @@ class ServiceClient:
             seq = self._key_seq
         return f"{self._key_prefix}-{seq}"
 
+    # -- breakers (one per endpoint) -----------------------------------
+    def breaker_for(self, url) -> CircuitBreaker:
+        url = str(url).rstrip("/")
+        with self._breakers_lock:
+            breaker = self._breakers.get(url)
+            if breaker is None:
+                breaker = CircuitBreaker(
+                    threshold=self._breaker_threshold,
+                    cooldown=self._breaker_cooldown,
+                )
+                self._breakers[url] = breaker
+            return breaker
+
+    @property
+    def breaker(self) -> CircuitBreaker:
+        """The default endpoint's breaker (back-compat accessor; the
+        real state is per-endpoint — see :meth:`breaker_for`)."""
+        return self.breaker_for(self.base_url)
+
+    # -- owner cache ---------------------------------------------------
+    def _note_owner(self, study_id, url):
+        with self._owner_lock:
+            if url is None:
+                self._owner_cache.pop(str(study_id), None)
+            else:
+                self._owner_cache[str(study_id)] = str(url).rstrip("/")
+
+    def _cached_owner(self, study_id):
+        with self._owner_lock:
+            return self._owner_cache.get(str(study_id))
+
     # -- transport -----------------------------------------------------
-    def _request(self, method, path, body=None, retryable=None, raw=False):
+    def _request(self, method, path, body=None, retryable=None, raw=False,
+                 base_url=None, max_transport_retries=None,
+                 fail_fast_on_open=False):
         if self.tracer is not None and self.tracer.enabled \
                 and tracing.current_trace() is None:
             # this client is the trace ROOT: begin one for the logical
@@ -166,27 +255,47 @@ class ServiceClient:
                 with tracing.use_trace(trace):
                     return self._request_traced(
                         method, path, body=body, retryable=retryable,
-                        raw=raw,
+                        raw=raw, base_url=base_url,
+                        max_transport_retries=max_transport_retries,
+                        fail_fast_on_open=fail_fast_on_open,
                     )
             finally:
                 self.tracer.finish(trace)
         return self._request_traced(
-            method, path, body=body, retryable=retryable, raw=raw
+            method, path, body=body, retryable=retryable, raw=raw,
+            base_url=base_url,
+            max_transport_retries=max_transport_retries,
+            fail_fast_on_open=fail_fast_on_open,
         )
 
     def _request_traced(self, method, path, body=None, retryable=None,
-                        raw=False):
+                        raw=False, base_url=None,
+                        max_transport_retries=None,
+                        fail_fast_on_open=False):
         with tracing.span(
             "client.request", method=method, route=path
         ) as sp:
             out = self._request_inner(
                 method, path, body=body, retryable=retryable, raw=raw,
-                root_span=sp,
+                root_span=sp, base_url=base_url,
+                max_transport_retries=max_transport_retries,
+                fail_fast_on_open=fail_fast_on_open,
             )
         return out
 
     def _request_inner(self, method, path, body=None, retryable=None,
-                       raw=False, root_span=tracing.NULL_SPAN):
+                       raw=False, root_span=tracing.NULL_SPAN,
+                       base_url=None, max_transport_retries=None,
+                       fail_fast_on_open=False):
+        base = (
+            self.base_url if base_url is None
+            else str(base_url).rstrip("/")
+        )
+        breaker = self.breaker_for(base)
+        retry_budget = (
+            self.max_transport_retries if max_transport_retries is None
+            else int(max_transport_retries)
+        )
         if retryable is None:
             # GETs are safe by definition; mutating routes are safe iff
             # they carry an idempotency key (the server replays instead
@@ -215,14 +324,15 @@ class ServiceClient:
             headers[tracing.TRACE_HEADER] = trace_id
         attempts = 0
         while True:
-            wait = self.breaker.before_request()
+            wait = breaker.before_request()
             if wait > 0.0:
                 if (
-                    not retryable
+                    fail_fast_on_open
+                    or not retryable
                     or time.monotonic() + wait > call_deadline
                 ):
                     raise CircuitOpenError(
-                        f"circuit open for {self.base_url} "
+                        f"circuit open for {base} "
                         f"(retry in {wait:.2f}s)",
                         retry_in=wait,
                     )
@@ -230,13 +340,13 @@ class ServiceClient:
                     time.sleep(wait)
                 continue
             req = urllib.request.Request(
-                self.base_url + path, data=data, headers=headers,
+                base + path, data=data, headers=headers,
                 method=method,
             )
             try:
                 with urllib.request.urlopen(req, timeout=self.timeout) as r:
                     raw_body = r.read()
-                    self.breaker.record_success()
+                    breaker.record_success()
                     root_span.set_attr("attempts", attempts + 1)
                     if raw:
                         return r.status, raw_body
@@ -247,7 +357,7 @@ class ServiceClient:
             except urllib.error.HTTPError as e:
                 # the server answered: the transport (and breaker) are
                 # fine, whatever the status says
-                self.breaker.record_success()
+                breaker.record_success()
                 raw_body = e.read()
                 try:
                     payload = json.loads(raw_body.decode())
@@ -256,6 +366,19 @@ class ServiceClient:
                         "error": "HTTPError",
                         "detail": raw_body.decode("utf-8", "replace"),
                     }
+                if e.code == 307:
+                    # not-owner redirect: surface the owner hint to the
+                    # routing loop (urllib never auto-follows a 307
+                    # POST, by design — re-sending the body is OUR call,
+                    # made safe by the idempotency key)
+                    raise ReplicaRedirect(
+                        owner_url=payload.get("owner_url")
+                        or e.headers.get("Location", "").rsplit(
+                            "/v1/", 1
+                        )[0] or None,
+                        owner_id=payload.get("owner_id"),
+                        payload=payload,
+                    )
                 if e.code in (429, 503):
                     retry_after = parse_retry_after(
                         e.headers.get("Retry-After")
@@ -274,7 +397,7 @@ class ServiceClient:
                     e.code, payload.get("error"), payload.get("detail")
                 )
             except _TRANSPORT_ERRORS as e:
-                self.breaker.record_failure()
+                breaker.record_failure()
                 attempts += 1
                 if not retryable:
                     raise ServiceTransportError(
@@ -286,7 +409,7 @@ class ServiceClient:
                     self._retry_policy, attempts, key=path
                 )
                 if (
-                    attempts > self.max_transport_retries
+                    attempts > retry_budget
                     or time.monotonic() + delay > call_deadline
                 ):
                     raise ServiceTransportError(
@@ -302,6 +425,147 @@ class ServiceClient:
                     "client.backoff", wait_s=delay, attempt=attempts
                 ):
                     time.sleep(delay)
+
+    # -- study routing (consistent hash + redirect + failover) ---------
+    def _candidates(self, study_id) -> list:
+        """Replica URLs to try for a study, in order: the last-known
+        owner first (learned from 307s and successful serves), then the
+        consistent-hash ring order (primary, successor, ...)."""
+        urls = (
+            self.ring.ordered(study_id) if self.ring is not None
+            else list(self._urls)
+        )
+        cached = self._cached_owner(study_id)
+        if cached is not None:
+            if cached in urls:
+                urls.remove(cached)
+            urls.insert(0, cached)
+        return urls
+
+    def _study_request(self, study_id, method, path, body=None,
+                       raw=False):
+        """One logical study-scoped request with replica routing.
+
+        Single-endpoint clients behave exactly like the pre-replica
+        client (full transport-retry budget against the one URL), plus
+        redirect-following when the server answers 307.  Multi-endpoint
+        clients fail over: each candidate gets a SHORT transport budget
+        and an open breaker fails fast to the ring successor; a full
+        pass over every replica backs off deterministically and retries
+        until the call deadline — a killed owner costs the client one
+        hop, not the whole retry budget."""
+        multi = self.ring is not None
+        # a mutation carrying no idempotency key must NOT be re-sent to
+        # another replica after a mid-flight transport error — the first
+        # send may have committed (same contract as the single-endpoint
+        # transport-retry gate; redirects/open breakers never sent, so
+        # those always fail over)
+        resend_safe = (
+            method == "GET"
+            or path == "/v1/shutdown"
+            or (isinstance(body, dict)
+                and body.get("idempotency_key") is not None)
+        )
+        deadline = time.monotonic() + self.deadline
+        attempts = 0
+        rounds = 0
+        last = None
+        while True:
+            candidates = self._candidates(study_id)
+            # fixed cap (NOT against the growing list: each 307 inserts
+            # a candidate, so a live cap would never bind and a
+            # stale-hint ping-pong between two replicas would hot-spin
+            # this loop forever)
+            max_redirect_hops = len(candidates) + 2
+            redirect_hops = 0
+            i = 0
+            while i < len(candidates):
+                url = candidates[i]
+                i += 1
+                attempts += 1
+                try:
+                    out = self._request(
+                        method, path, body=body, raw=raw, base_url=url,
+                        max_transport_retries=(
+                            self.failover_transport_retries
+                            if multi else None
+                        ),
+                        fail_fast_on_open=multi,
+                    )
+                except ReplicaRedirect as r:
+                    last = r
+                    self._note_owner(study_id, r.owner_url)
+                    if (
+                        r.owner_url
+                        and redirect_hops < max_redirect_hops
+                    ):
+                        # try the hinted owner next; the hop cap stops
+                        # a stale-hint ping-pong from spinning (the
+                        # outer backoff then takes over)
+                        redirect_hops += 1
+                        candidates.insert(i, r.owner_url.rstrip("/"))
+                    continue
+                except BackpressureError as e:
+                    # this replica is saturated or draining; the study
+                    # may be served instantly by its actual owner — a
+                    # backpressured candidate costs one hop, not the
+                    # whole logical call
+                    last = e
+                    if not multi:
+                        raise
+                    logger.debug(
+                        "failover: %s backpressured for study %s (%r)",
+                        url, study_id, e,
+                    )
+                    continue
+                except CircuitOpenError as e:
+                    # fail-fast: NO request was sent, so failover is
+                    # safe regardless of idempotency
+                    last = e
+                    if self._cached_owner(study_id) == url:
+                        self._note_owner(study_id, None)
+                    if not multi:
+                        raise
+                    continue
+                except ServiceTransportError as e:
+                    last = e
+                    if self._cached_owner(study_id) == url:
+                        self._note_owner(study_id, None)
+                    if not multi or not resend_safe:
+                        raise
+                    logger.debug(
+                        "failover: %s unreachable for study %s (%r)",
+                        url, study_id, e,
+                    )
+                    continue
+                self._note_owner(study_id, url)
+                return out
+            rounds += 1
+            if not multi:
+                # a redirect chain that never landed (single endpoint)
+                raise ServiceClientError(
+                    307, "NotOwner",
+                    f"redirect chain for study {study_id!r} did not "
+                    f"reach a serving owner: {last}",
+                )
+            delay = backoff_delay(
+                self._retry_policy, min(rounds, 10),
+                key=f"route:{study_id}",
+            )
+            if time.monotonic() + delay > deadline:
+                raise ServiceTransportError(
+                    f"no replica served {method} {path} after "
+                    f"{attempts} attempt(s) across {len(self._urls)} "
+                    f"replica(s): {last!r}",
+                    attempts=attempts,
+                    last_error=(
+                        last if isinstance(last, Exception) else None
+                    ),
+                )
+            with tracing.span(
+                "client.failover_backoff", wait_s=delay, round=rounds
+            ):
+                time.sleep(delay)
 
     # -- API -----------------------------------------------------------
     def healthz(self) -> bool:
@@ -366,7 +630,7 @@ class ServiceClient:
     def create_study(self, study_id, space, seed=0, algo="tpe",
                      algo_params=None, exist_ok=False,
                      idempotency_key=None) -> dict:
-        return self._request("POST", "/v1/studies", {
+        return self._study_request(study_id, "POST", "/v1/studies", {
             "study_id": study_id,
             "space_b64": encode_space(space),
             "seed": int(seed),
@@ -381,8 +645,8 @@ class ServiceClient:
 
     def suggest(self, study_id, n=1, idempotency_key=None) -> list:
         """[{"tid": int, "vals": {label: value}}, ...]"""
-        out = self._request(
-            "POST", f"/v1/studies/{_quote(study_id)}/suggest",
+        out = self._study_request(
+            study_id, "POST", f"/v1/studies/{_quote(study_id)}/suggest",
             {
                 "n": int(n),
                 "idempotency_key": (
@@ -407,12 +671,20 @@ class ServiceClient:
             body["loss"] = float(loss)
         if result is not None:
             body["result"] = result
-        return self._request(
-            "POST", f"/v1/studies/{_quote(study_id)}/report", body
+        return self._study_request(
+            study_id, "POST",
+            f"/v1/studies/{_quote(study_id)}/report", body,
         )
 
     def study_status(self, study_id) -> dict:
-        return self._request("GET", f"/v1/studies/{_quote(study_id)}")
+        return self._study_request(
+            study_id, "GET", f"/v1/studies/{_quote(study_id)}"
+        )
+
+    def replicas(self) -> dict:
+        """The ``GET /v1/replicas`` replica-plane document (identity,
+        held studies, takeover log, directory snapshot)."""
+        return self._request("GET", "/v1/replicas")
 
     def list_studies(self) -> list:
         return self._request("GET", "/v1/studies")["studies"]

@@ -78,6 +78,7 @@ from ..observability import (
     ServiceStats,
 )
 from ..utils import coarse_utcnow
+from .replicas import OwnershipLost
 
 logger = logging.getLogger(__name__)
 
@@ -152,6 +153,39 @@ class StudyNotFound(ServiceError):
 
 class StudyExists(ServiceError):
     """create_study collision without ``exist_ok`` (409)."""
+
+
+class NotOwner(ServiceError):
+    """This replica does not own the study (multi-replica mode).
+
+    Maps to **307 Temporary Redirect** with a ``Location`` header and an
+    ``owner_url`` body field when the owner has a live directory record,
+    or to a retryable **503** when the owner is unknown (the study is
+    mid-migration; the adopting replica serves it after takeover).
+    """
+
+    retry_after = 0.25
+
+    def __init__(self, study_id, owner_id=None, owner_url=None):
+        self.study_id = str(study_id)
+        self.owner_id = owner_id
+        self.owner_url = owner_url
+        if owner_url:
+            msg = (
+                f"study {self.study_id!r} is owned by replica "
+                f"{owner_id!r} at {owner_url}"
+            )
+        elif owner_id:
+            msg = (
+                f"study {self.study_id!r} is owned by replica "
+                f"{owner_id!r} (no live directory record)"
+            )
+        else:
+            msg = (
+                f"study {self.study_id!r} is not served by this replica "
+                f"(migrating; retry shortly)"
+            )
+        super().__init__(msg)
 
 
 def _null_objective(config):
@@ -499,6 +533,11 @@ class Study:
                 self._prepare = partial(self._prepare, mesh=mesh)
         self.domain = Domain(_null_objective, space)
         self.trials = trials if trials is not None else Trials()
+        # multi-replica mode: the serving replica's fencing-token
+        # credential (service.replicas.OwnershipHandle).  None in the
+        # single-process shape — every ownership check then costs one
+        # attribute read and nothing else.
+        self.ownership = None
         self.lock = threading.Lock()
         self.rstate = np.random.default_rng(self.seed)
         self.n_seeds_drawn = 0
@@ -659,6 +698,12 @@ class Study:
         insert into the store.  A crash between the two is repaired by
         :meth:`replay_journal`; a crash before the append recovers to
         "seed never consumed".  Returns the response payload."""
+        if self.ownership is not None:
+            # stale-fence drop: re-verify the replica lease immediately
+            # before the durable commit — a holder frozen past the TTL
+            # whose study was reclaimed must never land this write
+            # (raises OwnershipLost; nothing was journaled or stored)
+            self.ownership.verify()
         payload = None
         if draw_index is not None:
             for doc in docs:
@@ -724,6 +769,11 @@ class Study:
         failed evaluation), written through to the durable store.  With
         an idempotency key the response is journaled BEFORE the doc
         mutation (replay re-applies an unlanded result)."""
+        if self.ownership is not None:
+            # same stale-fence drop as commit_suggest: a reclaimed
+            # study's terminal writes are refused BEFORE any journal
+            # or store mutation
+            self.ownership.verify()
         doc, result = self._validate_result(
             tid, loss=loss, status=status, result=result
         )
@@ -851,10 +901,15 @@ class StudyRegistry:
 
     # lock-order: _create_lock < _studies_lock
     def __init__(self, root=None, max_studies=DEFAULT_MAX_STUDIES,
-                 mesh=None):
+                 mesh=None, replica_set=None):
         self.root = os.path.abspath(root) if root else None
         self.max_studies = int(max_studies)
         self.mesh = mesh  # the service's shared device mesh (or None)
+        # multi-replica mode: recovery and create claim per-study
+        # ownership leases through this ReplicaSet; a study another
+        # live replica holds is skipped at recovery and refused (307)
+        # at create.  None keeps the single-process behavior exactly.
+        self.replica_set = replica_set
         self._studies_lock = threading.Lock()
         # serializes whole create() calls: the capacity/exists check,
         # the on-disk side effects (study dir + config attachment), and
@@ -881,62 +936,102 @@ class StudyRegistry:
             self.root, "studies", validate_study_id(study_id)
         )
 
-    def _recover(self):
+    def load_study(self, study_id) -> Study:
+        """Rebuild one study from its on-disk queue directory: config
+        attachment → Study, journal replay, seed-cursor re-verify.  The
+        exactly-once recovery protocol, shared by startup recovery and
+        replica takeover.  Does NOT register the study — the caller
+        decides when it starts serving (takeover pre-warms first)."""
         from ..parallel.file_trials import FileTrials
 
+        qdir = self._study_dir(study_id)
+        trials = FileTrials(qdir)
+        blob = trials.attachments[STUDY_CONFIG_ATTACHMENT]
+        cfg = json.loads(blob.decode())
+        study = Study(
+            cfg["study_id"],
+            decode_space(cfg["space_b64"]),
+            cfg["seed"],
+            algo_name=cfg["algo_name"],
+            algo_params=cfg.get("algo_params") or {},
+            trials=trials,
+            mesh=self.mesh,
+        )
+        # exactly-once recovery: re-apply journal entries whose
+        # effects never landed (crash between journal append and
+        # store insert), THEN re-verify the seed cursor against
+        # the evidence in docs + journal — a stale cursor would
+        # re-issue a seed an existing trial already used
+        n_replayed = study.replay_journal()
+        self.recovery_info["journal_entries_replayed"] += n_replayed
+        self.recovery_info["torn_journal_lines"] += (
+            study.journal.n_torn_lines
+        )
+        try:
+            cursor = int(
+                trials.attachments[SEED_CURSOR_ATTACHMENT].decode()
+            )
+        except (KeyError, ValueError):
+            cursor = 0
+        evidenced = study.max_service_draw()
+        if evidenced > cursor:
+            cursor = evidenced
+            self.recovery_info["seed_cursors_repaired"] += 1
+        study.fast_forward_seeds(cursor)
+        study._persist_seed_cursor()
+        logger.info(
+            "recovered study %r (%d trials, %d suggests served, "
+            "%d journal entries replayed)",
+            study.study_id, len(study.trials._dynamic_trials),
+            study.n_seeds_drawn, n_replayed,
+        )
+        return study
+
+    def install(self, study: Study):
+        """Register a recovered/adopted study for serving."""
+        with self._studies_lock:
+            self._studies[study.study_id] = study
+
+    def remove(self, study_id) -> bool:
+        """Evict a study from serving (relinquished ownership).  The
+        on-disk state is untouched — the new owner recovers it."""
+        with self._studies_lock:
+            return self._studies.pop(str(study_id), None) is not None
+
+    def _recover(self):
         studies_dir = os.path.join(self.root, "studies")
         for name in sorted(os.listdir(studies_dir)):
             qdir = os.path.join(studies_dir, name)
             if not os.path.isdir(qdir):
                 continue
-            try:
-                trials = FileTrials(qdir)
-                blob = trials.attachments[STUDY_CONFIG_ATTACHMENT]
-                cfg = json.loads(blob.decode())
-                study = Study(
-                    cfg["study_id"],
-                    decode_space(cfg["space_b64"]),
-                    cfg["seed"],
-                    algo_name=cfg["algo_name"],
-                    algo_params=cfg.get("algo_params") or {},
-                    trials=trials,
-                    mesh=self.mesh,
-                )
-                # exactly-once recovery: re-apply journal entries whose
-                # effects never landed (crash between journal append and
-                # store insert), THEN re-verify the seed cursor against
-                # the evidence in docs + journal — a stale cursor would
-                # re-issue a seed an existing trial already used
-                n_replayed = study.replay_journal()
-                self.recovery_info["journal_entries_replayed"] += n_replayed
-                self.recovery_info["torn_journal_lines"] += (
-                    study.journal.n_torn_lines
-                )
-                try:
-                    cursor = int(
-                        trials.attachments[SEED_CURSOR_ATTACHMENT].decode()
+            handle = None
+            if self.replica_set is not None:
+                # claim-before-recover: a study another live replica
+                # holds is ITS tenant, not ours (no failure — skip);
+                # claimable studies (unheld, expired, released) are
+                # taken over with a bumped fence
+                handle = self.replica_set.try_claim(name)
+                if handle is None:
+                    logger.info(
+                        "study %r is leased to another replica; skipping",
+                        name,
                     )
-                except (KeyError, ValueError):
-                    cursor = 0
-                evidenced = study.max_service_draw()
-                if evidenced > cursor:
-                    cursor = evidenced
-                    self.recovery_info["seed_cursors_repaired"] += 1
-                study.fast_forward_seeds(cursor)
-                study._persist_seed_cursor()
+                    continue
+            try:
+                study = self.load_study(name)
             except Exception:
                 logger.exception("could not recover study dir %s", qdir)
                 self.recovery_info["failed_studies"] += 1
+                if handle is not None:
+                    # release so a healthier replica may try
+                    self.replica_set.leases.release(
+                        name, self.replica_set.replica_id, handle.fence
+                    )
+                    self.replica_set.drop(name)
                 continue
-            with self._studies_lock:
-                self._studies[study.study_id] = study
+            study.ownership = handle
+            self.install(study)
             self.recovery_info["recovered_studies"] += 1
-            logger.info(
-                "recovered study %r (%d trials, %d suggests served, "
-                "%d journal entries replayed)",
-                study.study_id, len(study.trials._dynamic_trials),
-                study.n_seeds_drawn, n_replayed,
-            )
 
     def create(self, study_id, space, seed=0, algo_name="tpe",
                algo_params=None, exist_ok=False) -> Study:
@@ -992,17 +1087,40 @@ class StudyRegistry:
                         f"the server's --mesh"
                     )
             Domain(_null_objective, space)
-            trials = None
-            if self.root:
-                from ..parallel.file_trials import FileTrials
+            handle = None
+            if self.replica_set is not None:
+                # ownership-before-side-effects: claim the study's
+                # lease BEFORE the directory exists, so a raced create
+                # on two replicas has exactly one winner (the fence
+                # bump is the linearization point) and the loser
+                # redirects with no orphan dir
+                handle = self.replica_set.try_claim(study_id)
+                if handle is None:
+                    owner, url = self.replica_set.owner_hint(study_id)
+                    raise NotOwner(
+                        study_id, owner_id=owner, owner_url=url
+                    )
+            try:
+                trials = None
+                if self.root:
+                    from ..parallel.file_trials import FileTrials
 
-                trials = FileTrials(self._study_dir(study_id))
-            study = Study(
-                study_id, space, seed,
-                algo_name=algo_name, algo_params=algo_params,
-                trials=trials, mesh=self.mesh,
-            )
-            study.persist_config()
+                    trials = FileTrials(self._study_dir(study_id))
+                study = Study(
+                    study_id, space, seed,
+                    algo_name=algo_name, algo_params=algo_params,
+                    trials=trials, mesh=self.mesh,
+                )
+                study.persist_config()
+            except Exception:
+                if handle is not None:
+                    self.replica_set.leases.release(
+                        study_id, self.replica_set.replica_id,
+                        handle.fence,
+                    )
+                    self.replica_set.drop(study_id)
+                raise
+            study.ownership = handle
             with self._studies_lock:
                 self._studies[study.study_id] = study
         return study
@@ -1648,7 +1766,9 @@ class OptimizationService:
                  slo_enabled=True, slo_rules=None, flight_dir=None,
                  slo_tick=None, compile_cache_dir=None, warmup=True,
                  cold_fallback=False, compile_ledger_path=None,
-                 compile_plane=True, mesh=None):
+                 compile_plane=True, mesh=None, replica_id=None,
+                 advertise_url=None, replica_ttl=None,
+                 takeover_prewarm=True):
         self.stats = ServiceStats()
         # mesh execution mode (--mesh auto|DPxSP|off): resolve the spec
         # ONCE — every study's fused prepare, the warmup replay, and
@@ -1746,8 +1866,37 @@ class OptimizationService:
         self._recovery_ok = True
         if root and startup_fsck:
             self._run_startup_fsck(root)
+        # multi-replica mode (--replica-id): N server processes share
+        # this root, each claiming per-study ownership through fencing-
+        # token heartbeat leases.  Built BEFORE the registry so startup
+        # recovery claims exactly the studies no live replica holds.
+        self.replica_set = None
+        self.takeover_prewarm = bool(takeover_prewarm)
+        # lock-order: _adopt_lock is only ever held to look up/create a
+        # per-study adopt lock, never across blocking work; the
+        # PER-STUDY lock is what serializes a takeover, so adopting
+        # study A (fsck + recover + a prewarm wait of minutes, worst
+        # case) cannot stall a client whose request adopts study B
+        self._adopt_lock = threading.Lock()
+        self._adopt_locks = {}  # guarded-by: _adopt_lock  (study_id -> Lock)
+        if replica_id is not None:
+            if not root:
+                raise ValueError(
+                    "multi-replica mode (replica_id) requires a durable "
+                    "--root shared between the replicas"
+                )
+            from .replicas import DEFAULT_REPLICA_LEASE_TTL, ReplicaSet
+
+            self.replica_set = ReplicaSet(
+                root, replica_id, url=advertise_url,
+                ttl=(
+                    DEFAULT_REPLICA_LEASE_TTL if replica_ttl is None
+                    else float(replica_ttl)
+                ),
+            )
         self.registry = StudyRegistry(
-            root, max_studies=max_studies, mesh=self.mesh
+            root, max_studies=max_studies, mesh=self.mesh,
+            replica_set=self.replica_set,
         )
         if self.registry.recovery_info["failed_studies"]:
             self._recovery_ok = False
@@ -1801,6 +1950,10 @@ class OptimizationService:
             service_stats=self.stats,
             device_stats=self.device_stats,
             store_stats=self.store_stats,
+            replica_stats=(
+                self.replica_set.stats
+                if self.replica_set is not None else None
+            ),
             rules=slo_rules,
             # guardrails off means no breach-triggered dumps either —
             # a /v1/alerts poll on a --no-slo server must stay passive
@@ -1822,6 +1975,13 @@ class OptimizationService:
             mesh_label=self.mesh_label,
         )
         self.suggest_timeout = float(suggest_timeout)
+        # replica plane goes live LAST: the heartbeat advertises this
+        # replica and the failure detector starts adopting dead
+        # replicas' studies only once the scheduler can serve them
+        if self.replica_set is not None:
+            self.replica_set.bind(
+                self._adopt_study, self._relinquish_study
+            ).start()
         self.started_at = time.time()
         self._closed = False
         # readiness: the device-warm probe runs once, on the first
@@ -1921,6 +2081,181 @@ class OptimizationService:
             ) else "warm"
         )
 
+    # -- replica plane ---------------------------------------------------
+    def _adopt_study(self, study_id, reason) -> bool:
+        """Warm takeover of one study: **claim → fsck-clean → recover →
+        ledger pre-warm → serve**, in that order.
+
+        The fence bump at claim time makes the old owner's in-flight
+        writes stale (dropped at their own verify); the fsck repairs
+        whatever its crash tore; the journal replay + seed cursor make
+        the trajectory continue byte-identically; and the scoped
+        :class:`~hyperopt_tpu.compile_ledger.WarmupDriver` replays the
+        shared compile ledger + a dry prepare probe so the FIRST
+        post-failover suggest hits an already-traced program — failover
+        never eats a compile storm.  Returns True when the study is
+        serving here afterwards."""
+        rs = self.replica_set
+        if rs is None or self._closed:
+            return False
+        with self._adopt_lock:
+            study_lock = self._adopt_locks.setdefault(
+                str(study_id), threading.Lock()
+            )
+        with study_lock:
+            try:
+                self.registry.get(study_id)
+                return True  # already serving (raced adoption)
+            except StudyNotFound:
+                pass
+            if not rs.adoption_should_attempt(study_id):
+                # a recent takeover of this study failed; don't re-run
+                # fsck + recovery + a fence bump for every request that
+                # misses the registry — wait out the backoff
+                return False
+            # the previous owner, for the takeover record (read before
+            # the claim overwrites it)
+            prior = rs.leases.read(study_id)
+            t0 = time.monotonic()
+            handle = rs.try_claim(study_id)
+            if handle is None:
+                return False  # a live owner beat us to it
+            record = {
+                "study_id": str(study_id),
+                "reason": str(reason),
+                "from_owner": (prior or {}).get("owner"),
+                "fence": handle.fence,
+                "fsck_clean": None,
+                "prewarm": None,
+                "ok": False,
+                "duration_s": None,
+            }
+            with self._traced_request(
+                "replica.takeover", study=str(study_id),
+                failover=True, reason=str(reason),
+            ) as (_trace, root):
+                try:
+                    from ..resilience.fsck import fsck_queue
+
+                    with tracing.span("takeover.fsck"):
+                        fsck = fsck_queue(
+                            self.registry._study_dir(study_id),
+                            repair=True,
+                        )
+                    record["fsck_clean"] = fsck.clean
+                    with tracing.span("takeover.recover"):
+                        study = self.registry.load_study(study_id)
+                    # pre-warm BEFORE cutover: ledger records + a dry
+                    # prepare probe for this study, replayed through
+                    # the real dispatch path (compiles are tagged
+                    # background — never request-path cold)
+                    if self.takeover_prewarm and self.compile_plane:
+                        from .. import compile_ledger as ledger_mod
+
+                        with tracing.span("takeover.prewarm"):
+                            driver = ledger_mod.WarmupDriver(
+                                ledger=self.compile_ledger,
+                                studies=[study],
+                                device_recovery=self.device_recovery,
+                                enabled=True,
+                                mesh=self.mesh,
+                            )
+                            driver.start()
+                            driver.wait(timeout=300.0)
+                        record["prewarm"] = driver.counts()
+                    study.ownership = handle
+                    self.registry.install(study)
+                except Exception as e:
+                    logger.exception(
+                        "takeover of study %r failed", study_id
+                    )
+                    record["error"] = repr(e)
+                    # release so another (healthier) replica may adopt
+                    rs.leases.release(
+                        study_id, rs.replica_id, handle.fence
+                    )
+                    rs.drop(study_id)
+                    record["duration_s"] = round(
+                        time.monotonic() - t0, 4
+                    )
+                    rs.stats.record_takeover(record)
+                    rs.adoption_result(study_id, False)
+                    return False
+                record["ok"] = True
+                record["duration_s"] = round(time.monotonic() - t0, 4)
+                root.set_attr("fence", handle.fence)
+                root.set_attr("duration_s", record["duration_s"])
+        rs.stats.record_takeover(record)
+        rs.adoption_result(study_id, True)
+        self.stats.set_n_studies(len(self.registry))
+        logger.info(
+            "adopted study %r from %r in %.3fs (%s; fsck_clean=%s)",
+            study_id, record["from_owner"], record["duration_s"],
+            reason, record["fsck_clean"],
+        )
+        return True
+
+    def _relinquish_study(self, study_id):
+        """Evict a study whose lease was reclaimed (we were presumed
+        dead but are alive): stop serving it immediately.  On-disk
+        state is untouched — the new owner already recovered it, and
+        any of our queued writes drop at their own fence verify."""
+        if self.registry.remove(study_id):
+            logger.warning(
+                "relinquished study %r (lease reclaimed)", study_id
+            )
+            self.stats.set_n_studies(len(self.registry))
+        if self.replica_set is not None:
+            self.replica_set.drop(study_id)
+
+    def _not_owner(self, study_id) -> NotOwner:
+        owner, url = self.replica_set.owner_hint(study_id)
+        return NotOwner(study_id, owner_id=owner, owner_url=url)
+
+    def _study_for_request(self, study_id) -> Study:
+        """Resolve a study for a serving request, enforcing replica
+        ownership: a locally-served study whose ownership lapsed is
+        relinquished and redirected; a study existing on disk but owned
+        elsewhere raises :class:`NotOwner` (307 with the owner hint);
+        an unowned on-disk study is adopted on demand (the client beat
+        the failure detector to it)."""
+        try:
+            study = self.registry.get(study_id)
+        except StudyNotFound:
+            if self.replica_set is None or self.registry.root is None:
+                raise
+            study = None
+        if study is not None:
+            if self.replica_set is not None:
+                handle = study.ownership
+                if handle is None or handle.lost:
+                    self._relinquish_study(study_id)
+                    raise self._not_owner(study_id)
+            return study
+        # not serving locally: known on disk?
+        qdir = self.registry._study_dir(study_id)
+        if not os.path.isdir(qdir):
+            raise StudyNotFound(f"no study {study_id!r}")
+        owner, url = self.replica_set.owner_hint(study_id)
+        if owner is not None:
+            raise NotOwner(study_id, owner_id=owner, owner_url=url)
+        # unowned (owner dead or released): adopt on demand
+        if self._adopt_study(study_id, "on_demand"):
+            return self.registry.get(study_id)
+        raise BackpressureError(
+            f"study {study_id!r} is migrating; retry shortly"
+        )
+
+    def replica_status(self) -> dict:
+        """The ``GET /v1/replicas`` document: this replica's identity,
+        held studies, takeover log, and the directory snapshot."""
+        self.stats.record_request("replicas")
+        if self.replica_set is None:
+            return {"replica_mode": False}
+        out = self.replica_set.status()
+        out["replica_mode"] = True
+        return out
+
     # -- API -----------------------------------------------------------
     def create_study(self, study_id, space, seed=0, algo="tpe",
                      algo_params=None, exist_ok=False,
@@ -1929,6 +2264,18 @@ class OptimizationService:
             "service.create_study", study=str(study_id)
         ) as (_trace, root):
             with self.timings.phase("create_study"):
+                if self.replica_set is not None:
+                    try:
+                        self.registry.get(study_id)
+                    except StudyNotFound:
+                        qdir = self.registry._study_dir(study_id)
+                        if os.path.isdir(qdir):
+                            # the study exists on disk under another
+                            # replica's (or a dead replica's) lease:
+                            # adopt or redirect BEFORE the exist_ok
+                            # logic — a blind re-create would clobber
+                            # the recovered trajectory
+                            self._study_for_request(study_id)
                 try:
                     study = self.registry.create(
                         study_id, space, seed=seed, algo_name=algo,
@@ -1995,7 +2342,7 @@ class OptimizationService:
         # (pending.compiled) OR a compile it sat in queue behind.  Only
         # requests untouched by compilation count as steady state.
         compiles_before = self.stats.n_compile_events
-        study = self.registry.get(study_id)
+        study = self._study_for_request(study_id)
         with self._traced_request(
             "service.suggest", study=str(study_id), n=int(n)
         ) as (trace, root):
@@ -2047,9 +2394,17 @@ class OptimizationService:
                     "suggest.admit", root.t0, pending.enqueued_at,
                     parent=root,
                 )
-            pending.wait(
-                self.suggest_timeout if timeout is None else timeout
-            )
+            try:
+                pending.wait(
+                    self.suggest_timeout if timeout is None else timeout
+                )
+            except OwnershipLost:
+                # the commit-time fence verify dropped this write: the
+                # study was reclaimed while the request was in flight.
+                # Relinquish and redirect — the client's retry replays
+                # (or re-executes) against the new owner's journal.
+                self._relinquish_study(study_id)
+                raise self._not_owner(study_id)
             if trace is not None:
                 # the search-health verdict at serve time, on the same
                 # span operators already read latency/roofline from
@@ -2084,32 +2439,38 @@ class OptimizationService:
 
     def report(self, study_id, tid, loss=None, status=STATUS_OK,
                result=None, idempotency_key=None) -> dict:
-        study = self.registry.get(study_id)
+        study = self._study_for_request(study_id)
         with self._traced_request(
             "service.report", study=str(study_id), tid=int(tid)
         ) as (_trace, root):
             with self.timings.phase("report"):
-                with study.lock:
-                    if idempotency_key is not None:
-                        replay = study.journal.payload(
-                            idempotency_key, kind="report"
-                        )
-                        if replay is not None:
-                            root.set_attr("replay", True)
-                            self.stats.record_replay("report")
-                            self.stats.record_request(
-                                "report", replay=True
+                try:
+                    with study.lock:
+                        if idempotency_key is not None:
+                            replay = study.journal.payload(
+                                idempotency_key, kind="report"
                             )
-                            return replay
-                    doc = study.report(
-                        tid, loss=loss, status=status, result=result,
-                        idempotency_key=idempotency_key,
-                    )
+                            if replay is not None:
+                                root.set_attr("replay", True)
+                                self.stats.record_replay("report")
+                                self.stats.record_request(
+                                    "report", replay=True
+                                )
+                                return replay
+                        doc = study.report(
+                            tid, loss=loss, status=status, result=result,
+                            idempotency_key=idempotency_key,
+                        )
+                except OwnershipLost:
+                    # stale-fenced terminal write, dropped before any
+                    # journal/store mutation — redirect to the owner
+                    self._relinquish_study(study_id)
+                    raise self._not_owner(study_id)
         self.stats.record_request("report")
         return {"tid": int(doc["tid"]), "state": doc["state"]}
 
     def study_status(self, study_id) -> dict:
-        study = self.registry.get(study_id)
+        study = self._study_for_request(study_id)
         with study.lock:
             out = study.status()
         self.stats.record_request("study_status")
@@ -2145,6 +2506,15 @@ class OptimizationService:
             "flight_recorder": self.flight_recorder.summary(),
             "warmup": self.warmup.progress_brief(),
             "compile_ledger": self.compile_ledger.summary(),
+            "replica": (
+                {
+                    "replica_id": self.replica_set.replica_id,
+                    "url": self.replica_set.url,
+                    "owned_studies": self.replica_set.owned_studies(),
+                    "stats": self.replica_set.stats.summary(),
+                }
+                if self.replica_set is not None else None
+            ),
         }
 
     def alerts(self) -> dict:
@@ -2262,6 +2632,32 @@ class OptimizationService:
         eta = self.warmup.progress_brief()["eta_s"]
         if eta is not None:
             extra["compile_warmup_eta_seconds"] = eta
+        if self.replica_set is not None:
+            # replica-plane gauges: fleet dashboards sum/compare these
+            # across replicas (identity lives in the scrape target)
+            rstats = self.replica_set.stats
+            extra.update({
+                "replica_studies_owned": len(
+                    self.replica_set.owned_studies()
+                ),
+                "replica_directory_size": len(
+                    self.replica_set.directory.replicas()
+                ),
+                "replica_takeovers_total": rstats.get("takeover"),
+                "replica_takeovers_slow_total": rstats.get(
+                    "takeover_slow"
+                ),
+                "replica_takeovers_failed_total": rstats.get(
+                    "takeover_failed"
+                ),
+                "replica_stale_writes_dropped_total": rstats.get(
+                    "stale_write_dropped"
+                ),
+                "replica_heartbeats_total": rstats.get("heartbeat"),
+                "replica_lease_renew_lost_total": rstats.get(
+                    "renew_lost"
+                ),
+            })
         return render_prometheus(
             timings=self.timings,
             faults=self.fault_stats,
@@ -2285,6 +2681,11 @@ class OptimizationService:
     def close(self, timeout=60.0):
         self._closed = True
         self.scheduler.close(timeout=timeout)
+        if self.replica_set is not None:
+            # graceful handover: release every held lease (fence
+            # preserved) so a successor claims instantly instead of
+            # waiting out the TTL, and withdraw the directory record
+            self.replica_set.close(release=True)
         self.slo.close()
         self.warmup.stop()
         self._uninstall_compile_observer()

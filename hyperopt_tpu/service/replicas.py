@@ -1,0 +1,943 @@
+"""The replica plane: leased study ownership across server processes.
+
+One optimization server per host was the PR 5-11 shape; this module
+lets N server processes share ONE store root and split the tenant
+population between them — the distributed-asynchronous evaluation model
+of Bergstra, Yamins & Cox (ICML 2013) taken from "one Mongo, many
+workers" to "one store, many serving replicas".  The pieces:
+
+- :class:`StudyLeaseStore` — per-study **fencing-token heartbeat
+  leases** under ``<root>/replicas/leases/``.  A study's suggests and
+  reports are served only by its lease holder.  Every claim bumps a
+  durable monotonic fence counter (its own file, ``<study>.fence`` —
+  never deleted by repair, so tokens stay monotonic across lease-file
+  reclamation); every durable write re-verifies ``(owner, fence)``
+  immediately before committing, so a frozen-then-resumed holder whose
+  study was reclaimed has its stale-fenced writes DROPPED (the PR 3
+  owner-re-verify discipline, one level up the stack).
+- :class:`ReplicaDirectory` — advisory replica records
+  (``<root>/replicas/registry/<replica_id>.json``: url + heartbeat)
+  used for owner hints (HTTP 307 redirects) and client discovery.
+  Advisory only: the lease fence, not the directory, is the safety
+  mechanism.
+- :class:`HashRing` — the client-side consistent-hash study→replica
+  map (SHA-256 points, virtual nodes).  Shared with
+  :class:`~hyperopt_tpu.service.client.ServiceClient` so every client
+  routes a study to the same first-choice replica without
+  coordination; redirect-on-not-owner corrects the misses.
+- :class:`ReplicaSet` — the per-process manager: claims studies,
+  renews all held leases on a heartbeat thread (a renewal that finds
+  its fence bumped marks the study LOST and the service relinquishes
+  it), and runs a :class:`LeaseReaper`-style failure detector that
+  adopts a dead replica's studies: **claim → fsck-clean → recover →
+  ledger pre-warm → serve**, in that order, so a migrating study's
+  first post-failover suggest never pays the cold-compile bill (the
+  takeover replays the shared compile ledger through PR 10's
+  ``WarmupDriver`` scoped to exactly the migrating studies).
+
+Exactly-once survives migration because everything that makes replay
+byte-identical — the response journal, the seed cursor, the
+idempotency keys — lives in the study directory both replicas share:
+the adopting replica replays the same journal the dead one wrote.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+
+from ..parallel.file_trials import (
+    DocCorrupt,
+    _atomic_write,
+    _decode_doc,
+    _write_doc,
+)
+
+logger = logging.getLogger(__name__)
+
+# Study-ownership lease time-to-live.  Longer than the trial-level
+# DEFAULT_LEASE_TTL would suggest: a takeover re-reads a whole study and
+# replays its compile grid, so false-positive failovers are expensive —
+# the TTL must comfortably exceed heartbeat jitter plus a GC pause.
+DEFAULT_REPLICA_LEASE_TTL = 10.0
+# A takeover (claim + fsck + recover + pre-warm) slower than this is an
+# SL608 MTTR violation — classified at record time so the SLO rule can
+# evaluate on counter deltas alone.
+DEFAULT_MTTR_BOUND_S = 30.0
+# A directory record whose heartbeat is older than ttl * this factor is
+# treated as a dead replica for OWNER-HINT purposes (advisory only; the
+# lease fence stays the safety mechanism).
+DIRECTORY_STALE_FACTOR = 3.0
+
+
+class OwnershipLost(RuntimeError):
+    """This replica's fence for a study is no longer current: the study
+    was reclaimed (we were presumed dead).  The write that discovered
+    it was DROPPED; the service must relinquish the study and redirect
+    the client to the new owner."""
+
+    def __init__(self, study_id, detail=""):
+        super().__init__(
+            f"ownership of study {study_id!r} lost{': ' if detail else ''}"
+            f"{detail}"
+        )
+        self.study_id = str(study_id)
+
+
+def _validate_replica_id(replica_id) -> str:
+    rid = str(replica_id)
+    if not rid or not all(
+        c.isalnum() or c in "._-" for c in rid
+    ) or not rid[0].isalnum() or len(rid) > 128:
+        raise ValueError(
+            f"invalid replica_id {replica_id!r}: use 1-128 chars of "
+            f"[A-Za-z0-9._-], starting alphanumeric"
+        )
+    return rid
+
+
+class StudyLeaseStore:
+    """Fencing-token ownership leases, one per study, under
+    ``<root>/replicas/leases/``.
+
+    Three files per study:
+
+    - ``<study>.lease`` — the current grant (owner, fence, expiry),
+      CRC-trailed like a trial doc (a torn lease reads as "no grant",
+      never as garbage ownership);
+    - ``<study>.fence`` — the monotonic fence counter, bumped by every
+      claim and NEVER deleted by reclamation or repair (deleting it
+      would reset tokens and let a stale holder's writes through);
+    - ``<study>.claimlock`` — the ``O_CREAT|O_EXCL`` cross-process
+      critical section every lease MUTATION runs under (claim, renew,
+      release), mirroring the id-allocator lock protocol.
+
+    ``verify`` is deliberately lockless (one file read on the write hot
+    path): a write is safe iff the lease still carries our (owner,
+    fence), because any competing claim MUST have bumped the fence
+    first.  The read→write window is the same deliberately-conservative
+    race :mod:`hyperopt_tpu.resilience.leases` documents at the trial
+    level; the failure mode it exists to stop — a holder frozen PAST
+    the TTL resuming after a reclaim — is fully closed, because the
+    reclaim's fence bump happened strictly before the resume.
+    """
+
+    # lock-order: _claim_mutex
+    def __init__(self, root, ttl=DEFAULT_REPLICA_LEASE_TTL):
+        self.root = os.path.abspath(root)
+        self.ttl = float(ttl)
+        self.leases_dir = os.path.join(self.root, "replicas", "leases")
+        os.makedirs(self.leases_dir, exist_ok=True)
+        # process-local gate in front of the cross-process claim lock,
+        # exactly like FileJobs's id-allocator: threads queue on a cheap
+        # mutex instead of contending on the O_EXCL spin loop
+        self._claim_mutex = threading.Lock()
+
+    # -- paths ---------------------------------------------------------
+    def lease_path(self, study_id):
+        from .core import validate_study_id
+
+        return os.path.join(
+            self.leases_dir, f"{validate_study_id(study_id)}.lease"
+        )
+
+    def fence_path(self, study_id):
+        from .core import validate_study_id
+
+        return os.path.join(
+            self.leases_dir, f"{validate_study_id(study_id)}.fence"
+        )
+
+    def _claim_lock_path(self, study_id):
+        from .core import validate_study_id
+
+        return os.path.join(
+            self.leases_dir, f"{validate_study_id(study_id)}.claimlock"
+        )
+
+    # -- raw reads (lockless) ------------------------------------------
+    def read(self, study_id):
+        """The lease doc (None when absent or torn — a torn lease is
+        "no grant": fsck FS409 quarantines the file, and the fence
+        counter, not the lease, carries the safety state)."""
+        try:
+            with open(self.lease_path(study_id), "rb") as f:
+                raw = f.read()
+        except (FileNotFoundError, OSError):
+            return None
+        try:
+            return _decode_doc(raw)
+        except DocCorrupt:
+            return None
+
+    def read_fence(self, study_id) -> int:
+        try:
+            with open(self.fence_path(study_id)) as f:
+                return int(f.read().strip() or 0)
+        except (FileNotFoundError, ValueError, OSError):
+            return 0
+
+    def is_live(self, lease) -> bool:
+        """Does this lease doc currently grant ownership?"""
+        if lease is None or not lease.get("owner"):
+            return False
+        try:
+            return float(lease["expires_at"]) > time.time()
+        except (KeyError, TypeError, ValueError):
+            return False
+
+    def owner_of(self, study_id):
+        """``(owner, fence, live)`` — owner may be None (released or
+        never claimed)."""
+        lease = self.read(study_id)
+        if lease is None:
+            return None, self.read_fence(study_id), False
+        return (
+            lease.get("owner"),
+            int(lease.get("fence", 0)),
+            self.is_live(lease),
+        )
+
+    def verify(self, study_id, owner, fence) -> bool:
+        """Is ``(owner, fence)`` still the current grant?  THE write-
+        path re-verify: called immediately before every durable commit
+        of a replica-owned study.  Fence equality (not expiry) is the
+        test — an expired-but-unreclaimed lease is still safely ours,
+        because any reclaim must bump the fence first."""
+        lease = self.read(study_id)
+        return (
+            lease is not None
+            and lease.get("owner") == owner
+            and int(lease.get("fence", 0)) == int(fence)
+        )
+
+    def study_ids(self):
+        """Study ids with any lease state on disk (sorted)."""
+        out = set()
+        try:
+            names = os.listdir(self.leases_dir)
+        except OSError:
+            return []
+        for name in names:
+            for suffix in (".lease", ".fence"):
+                if name.endswith(suffix):
+                    out.add(name[: -len(suffix)])
+        return sorted(out)
+
+    # -- the cross-process critical section ----------------------------
+    @contextlib.contextmanager
+    def _claim_locked(self, study_id, timeout=10.0):
+        lock = self._claim_lock_path(study_id)
+        with self._claim_mutex:
+            deadline = time.monotonic() + float(timeout)
+            while True:
+                try:
+                    fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                    os.close(fd)
+                    break
+                except FileExistsError:
+                    if time.monotonic() > deadline:
+                        # a claimant SIGKILL'd inside the critical
+                        # section: steal the lock if it is older than
+                        # the TTL (fsck FS409 also clears these)
+                        try:
+                            age = time.time() - os.path.getmtime(lock)
+                        except OSError:
+                            continue
+                        if age > self.ttl:
+                            try:
+                                os.unlink(lock)
+                            except FileNotFoundError:
+                                pass
+                            continue
+                        raise TimeoutError(
+                            f"claim lock stuck for study {study_id!r}: "
+                            f"{lock}"
+                        )
+                    time.sleep(0.005)
+            try:
+                yield
+            finally:
+                try:
+                    os.unlink(lock)
+                except FileNotFoundError:
+                    pass
+
+    # -- mutations (all under the claim lock) --------------------------
+    def claim(self, study_id, owner, ttl=None):
+        """Claim ownership: the new fence token (int), or None when a
+        DIFFERENT replica holds a live lease.  Re-claiming a study we
+        already hold renews it and returns the existing fence (no
+        bump — our own writes must stay current)."""
+        owner = _validate_replica_id(owner)
+        ttl = self.ttl if ttl is None else float(ttl)
+        with self._claim_locked(study_id):
+            lease = self.read(study_id)
+            now = time.time()
+            if self.is_live(lease):
+                if lease.get("owner") != owner:
+                    return None
+                # already ours: renew in place, same fence
+                lease["expires_at"] = now + ttl
+                _write_doc(
+                    self.lease_path(study_id), lease, fsync_kind="lease"
+                )
+                return int(lease["fence"])
+            # expired, released, torn, or never granted: take over with
+            # a bumped fence.  The fence counter is the durable floor —
+            # a torn/absent lease file can never hand out a stale token.
+            fence = max(
+                self.read_fence(study_id),
+                int(lease.get("fence", 0)) if lease else 0,
+            ) + 1
+            _atomic_write(
+                self.fence_path(study_id), str(fence).encode(),
+                fsync_kind="lease",
+            )
+            _write_doc(
+                self.lease_path(study_id),
+                {
+                    "study_id": str(study_id),
+                    "owner": owner,
+                    "fence": fence,
+                    "granted_at": now,
+                    "expires_at": now + ttl,
+                },
+                fsync_kind="lease",
+            )
+            return fence
+
+    def renew(self, study_id, owner, fence, ttl=None) -> bool:
+        """Extend the lease iff ``(owner, fence)`` still holds it.
+        False means the study was reclaimed — the caller must mark the
+        study LOST and drop in-flight results."""
+        ttl = self.ttl if ttl is None else float(ttl)
+        with self._claim_locked(study_id):
+            lease = self.read(study_id)
+            if (
+                lease is None
+                or lease.get("owner") != owner
+                or int(lease.get("fence", 0)) != int(fence)
+            ):
+                return False
+            lease["expires_at"] = time.time() + ttl
+            _write_doc(
+                self.lease_path(study_id), lease, fsync_kind="lease"
+            )
+            return True
+
+    def release(self, study_id, owner, fence) -> bool:
+        """Graceful handover: clear the owner (fence preserved) so a
+        successor's claim succeeds immediately instead of waiting out
+        the TTL.  No-op unless ``(owner, fence)`` still holds it."""
+        with self._claim_locked(study_id):
+            lease = self.read(study_id)
+            if (
+                lease is None
+                or lease.get("owner") != owner
+                or int(lease.get("fence", 0)) != int(fence)
+            ):
+                return False
+            lease["owner"] = None
+            lease["expires_at"] = 0.0
+            lease["released_at"] = time.time()
+            _write_doc(
+                self.lease_path(study_id), lease, fsync_kind="lease"
+            )
+            return True
+
+
+class ReplicaDirectory:
+    """Advisory replica records under ``<root>/replicas/registry/``.
+
+    One JSON doc per replica (CRC-trailed; a torn record reads as
+    absent): ``{replica_id, url, heartbeat_at, pid}``.  The heartbeat
+    thread re-stamps it each beat; clients and redirect handlers read
+    it for owner hints and discovery.  Advisory ONLY — correctness
+    never depends on it (the lease fence does that), so a stale record
+    costs at worst one redirect hop.
+    """
+
+    def __init__(self, root, ttl=DEFAULT_REPLICA_LEASE_TTL):
+        self.root = os.path.abspath(root)
+        self.ttl = float(ttl)
+        self.registry_dir = os.path.join(self.root, "replicas", "registry")
+        # the directory is created on first WRITE (advertise), not
+        # here: read-side users (client discovery over a service root,
+        # possibly a read-only mount) must not mutate the store layout
+
+    def record_path(self, replica_id):
+        return os.path.join(
+            self.registry_dir, f"{_validate_replica_id(replica_id)}.json"
+        )
+
+    def advertise(self, replica_id, url):
+        os.makedirs(self.registry_dir, exist_ok=True)
+        _write_doc(
+            self.record_path(replica_id),
+            {
+                "replica_id": _validate_replica_id(replica_id),
+                "url": url,
+                "heartbeat_at": time.time(),
+                "pid": os.getpid(),
+            },
+            fsync_kind="attachment",
+        )
+
+    def withdraw(self, replica_id):
+        try:
+            os.unlink(self.record_path(replica_id))
+        except (FileNotFoundError, OSError):
+            pass
+
+    def lookup(self, replica_id):
+        try:
+            with open(self.record_path(replica_id), "rb") as f:
+                raw = f.read()
+        except (FileNotFoundError, OSError):
+            return None
+        try:
+            return _decode_doc(raw)
+        except DocCorrupt:
+            return None
+
+    def is_live(self, record) -> bool:
+        if record is None:
+            return False
+        try:
+            age = time.time() - float(record["heartbeat_at"])
+        except (KeyError, TypeError, ValueError):
+            return False
+        return age <= self.ttl * DIRECTORY_STALE_FACTOR
+
+    def url_of(self, replica_id):
+        """The advertised URL iff the record looks live (else None)."""
+        record = self.lookup(replica_id)
+        if self.is_live(record):
+            return record.get("url")
+        return None
+
+    def replicas(self) -> list:
+        """Every parseable record, sorted by replica_id."""
+        out = []
+        try:
+            names = sorted(os.listdir(self.registry_dir))
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            record = self.lookup(name[: -len(".json")])
+            if record is not None:
+                record["live"] = self.is_live(record)
+                out.append(record)
+        return out
+
+
+class HashRing:
+    """Consistent-hash study→replica routing (SHA-256 points,
+    ``n_virtual`` virtual nodes per replica).
+
+    Deterministic in the URL set alone, so every client — and the
+    campaign's fault-free twin — maps a study to the same first-choice
+    replica with zero coordination.  ``ordered`` returns EVERY distinct
+    replica in ring order from the study's point: element 0 is the
+    primary, element 1 the failover successor, and so on.
+    """
+
+    def __init__(self, urls, n_virtual=64):
+        self.urls = sorted(set(str(u).rstrip("/") for u in urls))
+        if not self.urls:
+            raise ValueError("HashRing needs at least one replica URL")
+        points = []
+        for url in self.urls:
+            for i in range(int(n_virtual)):
+                points.append((self._hash(f"{url}#{i}"), url))
+        points.sort()
+        self._points = points
+
+    @staticmethod
+    def _hash(key) -> int:
+        return int.from_bytes(
+            hashlib.sha256(str(key).encode()).digest()[:8], "big"
+        )
+
+    def ordered(self, study_id) -> list:
+        """All distinct replica URLs in ring order from the study's
+        hash point (primary first)."""
+        if len(self.urls) == 1:
+            return list(self.urls)
+        h = self._hash(study_id)
+        points = self._points
+        # first point at or after h (wrapping)
+        lo, hi = 0, len(points)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if points[mid][0] < h:
+                lo = mid + 1
+            else:
+                hi = mid
+        out, seen = [], set()
+        for i in range(len(points)):
+            url = points[(lo + i) % len(points)][1]
+            if url not in seen:
+                seen.add(url)
+                out.append(url)
+                if len(out) == len(self.urls):
+                    break
+        return out
+
+    def primary(self, study_id) -> str:
+        return self.ordered(study_id)[0]
+
+
+def read_discovery(path) -> list:
+    """Replica URLs from a discovery source: a JSON file
+    (``{"replicas": [url, ...]}`` or a bare list), or a service-root /
+    registry directory whose live records supply the URLs."""
+    path = os.path.abspath(path)
+    if os.path.isdir(path):
+        root = path
+        # accept the service root, <root>/replicas, or the registry dir
+        for candidate in (
+            path,
+            os.path.dirname(os.path.dirname(path)),
+            os.path.dirname(path),
+        ):
+            if os.path.isdir(
+                os.path.join(candidate, "replicas", "registry")
+            ):
+                root = candidate
+                break
+        directory = ReplicaDirectory(root)
+        return [
+            r["url"] for r in directory.replicas()
+            if r.get("url") and r.get("live")
+        ]
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        doc = doc.get("replicas", [])
+    return [str(u) for u in doc]
+
+
+class ReplicaStats:
+    """Counters + bounded takeover log for the replica plane — the
+    ``/metrics`` gauge source and the SL608 failover-MTTR feed."""
+
+    # lock-order: _lock
+    def __init__(self, mttr_bound_s=DEFAULT_MTTR_BOUND_S):
+        self.mttr_bound_s = float(mttr_bound_s)
+        self._lock = threading.Lock()
+        self._counts = {}  # guarded-by: _lock
+        self._takeovers = deque(maxlen=64)  # guarded-by: _lock
+
+    def record(self, event, n=1):
+        with self._lock:
+            self._counts[event] = self._counts.get(event, 0) + int(n)
+
+    def get(self, event) -> int:
+        with self._lock:
+            return self._counts.get(event, 0)
+
+    def record_takeover(self, record: dict):
+        """One completed (or failed) takeover.  ``record`` carries
+        study_id/from_owner/fence/duration_s/fsck_clean/prewarm/ok;
+        slowness is classified HERE against ``mttr_bound_s`` so SL608
+        evaluates on counter deltas alone."""
+        with self._lock:
+            self._takeovers.append(dict(record))
+            self._counts["takeover"] = self._counts.get("takeover", 0) + 1
+            if not record.get("ok", True):
+                self._counts["takeover_failed"] = (
+                    self._counts.get("takeover_failed", 0) + 1
+                )
+            elif record.get("duration_s", 0.0) > self.mttr_bound_s:
+                self._counts["takeover_slow"] = (
+                    self._counts.get("takeover_slow", 0) + 1
+                )
+
+    def takeovers(self) -> list:
+        with self._lock:
+            return [dict(r) for r in self._takeovers]
+
+    def slo_counters(self) -> dict:
+        """The scalar counters the SLO engine snapshots per tick (the
+        SL608 numerator/denominator)."""
+        with self._lock:
+            return {
+                "replica_takeovers": self._counts.get("takeover", 0),
+                "replica_takeovers_slow": self._counts.get(
+                    "takeover_slow", 0
+                ),
+                "replica_takeovers_failed": self._counts.get(
+                    "takeover_failed", 0
+                ),
+                "replica_stale_writes_dropped": self._counts.get(
+                    "stale_write_dropped", 0
+                ),
+            }
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "counts": dict(sorted(self._counts.items())),
+                "mttr_bound_s": self.mttr_bound_s,
+                "recent_takeovers": [dict(r) for r in self._takeovers],
+            }
+
+
+class OwnershipHandle:
+    """One study's ownership credential on its serving replica.
+
+    Attached to :class:`~hyperopt_tpu.service.core.Study`; the commit
+    paths call :meth:`verify` immediately before every durable write.
+    ``lost`` latches when a heartbeat renewal discovers the fence was
+    bumped — verifies then fail without a disk read."""
+
+    __slots__ = ("replica_set", "study_id", "fence", "_lost")
+
+    def __init__(self, replica_set: "ReplicaSet", study_id, fence):
+        self.replica_set = replica_set
+        self.study_id = str(study_id)
+        self.fence = int(fence)
+        self._lost = threading.Event()
+
+    @property
+    def lost(self) -> bool:
+        return self._lost.is_set()
+
+    def mark_lost(self):
+        self._lost.set()
+
+    def verify(self):
+        """Raise :class:`OwnershipLost` unless this replica still holds
+        the study at this fence — the stale-fenced-write drop."""
+        if self._lost.is_set() or not self.replica_set.leases.verify(
+            self.study_id, self.replica_set.replica_id, self.fence
+        ):
+            self._lost.set()
+            self.replica_set.stats.record("stale_write_dropped")
+            raise OwnershipLost(
+                self.study_id,
+                detail=f"fence {self.fence} superseded",
+            )
+
+
+class ReplicaSet:
+    """The per-process replica manager: identity, held leases, the
+    heartbeat, and the dead-replica failure detector.
+
+    The service binds itself via :meth:`bind` (adopt + relinquish
+    callbacks) and then :meth:`start` launches two daemon threads:
+
+    - **heartbeat** (ttl/3 cadence): advertise the directory record,
+      renew every held lease; a renewal that finds its fence bumped
+      marks the study LOST and relinquishes it from serving (its
+      in-flight writes drop at their own verify).  The chaos harness's
+      ``lease_stall`` site freezes this thread past the TTL to model a
+      stop-the-world-paused holder.
+    - **reaper** (ttl/4 cadence): scan the shared root for studies
+      whose lease is expired, released, or absent and adopt them
+      through the service callback (claim → fsck → recover → pre-warm
+      → serve).  Fencing makes double-adoption impossible: the claim
+      is the linearization point.
+    """
+
+    # lock-order: _lock
+    def __init__(self, root, replica_id, url=None,
+                 ttl=DEFAULT_REPLICA_LEASE_TTL, stats=None,
+                 mttr_bound_s=DEFAULT_MTTR_BOUND_S):
+        self.root = os.path.abspath(root)
+        self.replica_id = _validate_replica_id(replica_id)
+        self.url = url
+        self.ttl = float(ttl)
+        self.leases = StudyLeaseStore(self.root, ttl=self.ttl)
+        self.directory = ReplicaDirectory(self.root, ttl=self.ttl)
+        self.stats = (
+            stats if stats is not None
+            else ReplicaStats(mttr_bound_s=mttr_bound_s)
+        )
+        self._lock = threading.Lock()
+        self._owned = {}  # guarded-by: _lock  (study_id -> OwnershipHandle)
+        self._adopt = None  # service callback: adopt(study_id, reason)
+        self._relinquish = None  # service callback: relinquish(study_id)
+        self._stop = threading.Event()
+        self._hb_thread = None
+        self._reap_thread = None
+        self._closed = False  # guarded-by: _lock
+        # study_id -> (fail_count, earliest-next-attempt monotonic);
+        # an unrecoverable study (takeover keeps failing) is retried
+        # with capped exponential backoff instead of fence-bumping +
+        # re-fscking it on every reaper tick AND every client request
+        # that misses the registry
+        self._adopt_retry = {}  # guarded-by: _lock
+
+    # -- service binding ------------------------------------------------
+    def bind(self, adopt, relinquish):
+        """Install the service's adopt/relinquish callbacks (must happen
+        before :meth:`start`)."""
+        self._adopt = adopt
+        self._relinquish = relinquish
+        return self
+
+    def set_url(self, url):
+        self.url = url
+
+    # -- ownership ------------------------------------------------------
+    def try_claim(self, study_id):
+        """Claim ``study_id`` and register the handle; None when another
+        replica holds it live."""
+        fence = self.leases.claim(study_id, self.replica_id)
+        if fence is None:
+            return None
+        handle = OwnershipHandle(self, study_id, fence)
+        with self._lock:
+            self._owned[str(study_id)] = handle
+        self.stats.record("claim")
+        return handle
+
+    def owns(self, study_id) -> bool:
+        with self._lock:
+            handle = self._owned.get(str(study_id))
+        return handle is not None and not handle.lost
+
+    def handle_of(self, study_id):
+        with self._lock:
+            return self._owned.get(str(study_id))
+
+    def owned_studies(self) -> list:
+        with self._lock:
+            return sorted(
+                sid for sid, h in self._owned.items() if not h.lost
+            )
+
+    def drop(self, study_id):
+        """Forget a study (after relinquish or a failed adopt) without
+        touching the lease on disk."""
+        with self._lock:
+            self._owned.pop(str(study_id), None)
+
+    def release_all(self):
+        """Graceful handover on close: release every held lease (fence
+        preserved) so a successor claims instantly."""
+        with self._lock:
+            owned = list(self._owned.items())
+            self._owned.clear()
+        for study_id, handle in owned:
+            if handle.lost:
+                continue
+            try:
+                self.leases.release(
+                    study_id, self.replica_id, handle.fence
+                )
+                self.stats.record("release")
+            except OSError:
+                logger.warning(
+                    "could not release lease for %r", study_id,
+                    exc_info=True,
+                )
+
+    def owner_hint(self, study_id):
+        """``(owner_id, owner_url)`` for a study another replica holds
+        (url None when the owner has no live directory record)."""
+        owner, _fence, live = self.leases.owner_of(study_id)
+        if not owner or not live or owner == self.replica_id:
+            return None, None
+        return owner, self.directory.url_of(owner)
+
+    # -- heartbeat ------------------------------------------------------
+    def _heartbeat_once(self):
+        try:
+            self.directory.advertise(self.replica_id, self.url)
+        except OSError:
+            logger.warning("replica advertise failed", exc_info=True)
+        self.stats.record("heartbeat")
+        with self._lock:
+            owned = list(self._owned.items())
+        for study_id, handle in owned:
+            if handle.lost:
+                continue
+            try:
+                ok = self.leases.renew(
+                    study_id, self.replica_id, handle.fence
+                )
+            except (OSError, TimeoutError):
+                logger.warning(
+                    "lease renewal errored for %r", study_id,
+                    exc_info=True,
+                )
+                continue  # transient: the TTL absorbs one missed beat
+            if not ok:
+                # reclaimed out from under us: we were presumed dead.
+                # Drop serving immediately; queued writes fall to their
+                # own fence verify.
+                handle.mark_lost()
+                self.stats.record("renew_lost")
+                logger.warning(
+                    "lease for study %r was reclaimed (fence %d "
+                    "superseded); relinquishing", study_id, handle.fence,
+                )
+                if self._relinquish is not None:
+                    try:
+                        self._relinquish(study_id)
+                    except Exception:
+                        logger.exception(
+                            "relinquish callback failed for %r", study_id
+                        )
+
+    def _heartbeat_loop(self):
+        interval = max(self.ttl / 3.0, 0.05)
+        while not self._stop.wait(interval):
+            monkey = _active_chaos()
+            if monkey is not None:
+                stall = monkey.maybe_lease_stall(self.replica_id)
+                if stall > 0.0:
+                    # a frozen holder: NO renewals for the stall (the
+                    # stop event still honors close)
+                    self._stop.wait(stall)
+                    continue
+            try:
+                self._heartbeat_once()
+            except Exception:
+                logger.exception("replica heartbeat failed; continuing")
+
+    # -- failure detector -----------------------------------------------
+    def reap_once(self) -> int:
+        """One adoption scan: claim every study whose lease is expired,
+        released, or absent (including studies that have never been
+        claimed — a pre-replica root being upgraded in place).  Returns
+        the number of studies adopted."""
+        if self._adopt is None:
+            return 0
+        studies_dir = os.path.join(self.root, "studies")
+        try:
+            names = sorted(os.listdir(studies_dir))
+        except OSError:
+            return 0
+        n = 0
+        with self._lock:
+            self._adopt_retry = {
+                k: v for k, v in self._adopt_retry.items()
+                if k in names
+            }
+        for study_id in names:
+            if not os.path.isdir(os.path.join(studies_dir, study_id)):
+                continue
+            if self.owns(study_id):
+                continue
+            lease = self.leases.read(study_id)
+            if self.leases.is_live(lease):
+                continue  # someone (possibly a past us) holds it
+            if not self.adoption_should_attempt(study_id):
+                continue  # recent takeover failure: still backing off
+            reason = (
+                "unclaimed" if lease is None or not lease.get("owner")
+                else "expired"
+            )
+            try:
+                if self._adopt(study_id, reason):
+                    n += 1
+            except Exception:
+                # the service's adopt callback records its own failures
+                # (and never raises); a raising callback still gets the
+                # backoff so the reaper can't hot-loop it
+                logger.exception("adoption of study %r failed", study_id)
+                self.adoption_result(study_id, False)
+        return n
+
+    def adoption_should_attempt(self, study_id) -> bool:
+        """False while ``study_id`` is inside the failed-takeover
+        backoff window — consulted by the reaper AND the on-demand
+        (request-path) adoption, so N clients polling one broken study
+        cannot re-run fsck + recovery + a fence bump per request."""
+        with self._lock:
+            _fails, not_before = self._adopt_retry.get(
+                str(study_id), (0, 0.0)
+            )
+        return time.monotonic() >= not_before
+
+    def adoption_result(self, study_id, ok):
+        """Record a takeover outcome: success clears the backoff,
+        failure doubles it (capped)."""
+        with self._lock:
+            if ok:
+                self._adopt_retry.pop(str(study_id), None)
+                return
+            fails, _ = self._adopt_retry.get(str(study_id), (0, 0.0))
+            fails += 1
+            delay = min(self.ttl * (2.0 ** min(fails, 8)), 300.0)
+            self._adopt_retry[str(study_id)] = (
+                fails, time.monotonic() + delay
+            )
+
+    def _reap_loop(self):
+        interval = max(self.ttl / 4.0, 0.05)
+        while not self._stop.wait(interval):
+            try:
+                self.reap_once()
+            except Exception:
+                logger.exception("replica reaper scan failed; continuing")
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self):
+        with self._lock:
+            if self._closed or self._hb_thread is not None:
+                return self
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop,
+                name=f"hyperopt-replica-heartbeat-{self.replica_id}",
+                daemon=True,
+            )
+            self._reap_thread = threading.Thread(
+                target=self._reap_loop,
+                name=f"hyperopt-replica-reaper-{self.replica_id}",
+                daemon=True,
+            )
+        # first advertise + renewals synchronously, so the directory
+        # record exists before any client asks for owner hints
+        try:
+            self._heartbeat_once()
+        except Exception:
+            logger.exception("initial replica heartbeat failed")
+        self._hb_thread.start()
+        self._reap_thread.start()
+        return self
+
+    def close(self, release=True):
+        with self._lock:
+            self._closed = True
+        self._stop.set()
+        for t in (self._hb_thread, self._reap_thread):
+            if t is not None and t.is_alive():
+                t.join(timeout=5.0)
+        if release:
+            self.release_all()
+            try:
+                self.directory.withdraw(self.replica_id)
+            except OSError:
+                pass
+
+    def status(self) -> dict:
+        return {
+            "replica_id": self.replica_id,
+            "url": self.url,
+            "ttl": self.ttl,
+            "owned_studies": self.owned_studies(),
+            "directory": self.directory.replicas(),
+            "stats": self.stats.summary(),
+        }
+
+
+def _active_chaos():
+    """The process-wide chaos monkey (None when the harness was never
+    loaded) — same zero-cost lookup the store uses."""
+    from ..parallel.file_trials import _active_chaos as impl
+
+    return impl()

@@ -24,6 +24,10 @@ API (all JSON unless noted)::
                                           compiling/warm/skipped/error),
                                           ETA from ledger durations,
                                           compile-ledger summary
+    GET  /v1/replicas                     replica-plane document: this
+                                          replica's identity, held
+                                          studies, takeover log, and the
+                                          live replica directory
     GET  /v1/studies                      {"studies": [id, ...]}
     GET  /v1/studies/<id>                 study status document
     POST /v1/studies                      create: {"study_id", "space_b64",
@@ -40,6 +44,11 @@ header (retry is always safe — a rejected request had no side effects);
 a draining server returns **503**; unknown studies **404**; create
 collisions **409**; malformed requests **400**.  Suggest waits are
 bounded by the service's ``suggest_timeout`` and surface as **504**.
+In multi-replica mode a study served by another replica answers **307
+Temporary Redirect** with a ``Location`` header and an ``owner_url``
+body field (re-issue the same body there; idempotency keys make the
+re-send safe), or a retryable **503** while the owner is unknown
+(mid-migration).
 
 Exactly-once contract: the mutating routes (``create``, ``suggest``,
 ``report``) accept a client-generated ``idempotency_key`` in the body.
@@ -63,6 +72,7 @@ from .. import tracing
 from ..base import STATUS_OK
 from .core import (
     BackpressureError,
+    NotOwner,
     OptimizationService,
     ServiceDraining,
     StudyExists,
@@ -147,6 +157,30 @@ class _Handler(BaseHTTPRequestHandler):
     def _dispatch(self, handler):
         try:
             handler()
+        except NotOwner as e:
+            # multi-replica routing: 307 + owner hint when the lease
+            # holder has a live directory record (the client re-issues
+            # the SAME body there — idempotency keys make that safe);
+            # retryable 503 while the owner is unknown (mid-migration)
+            if e.owner_url:
+                path = self.path.split("?", 1)[0]
+                self._send(
+                    307,
+                    {
+                        "error": "NotOwner",
+                        "detail": str(e),
+                        "owner_url": e.owner_url,
+                        "owner_id": e.owner_id,
+                        "study_id": e.study_id,
+                    },
+                    headers=(
+                        ("Location", e.owner_url.rstrip("/") + path),
+                    ),
+                )
+            else:
+                self._send_error_json(
+                    503, e, retry_after=e.retry_after
+                )
         except BackpressureError as e:
             self._send_error_json(429, e, retry_after=e.retry_after)
         except ServiceDraining as e:
@@ -188,9 +222,30 @@ class _Handler(BaseHTTPRequestHandler):
         self.close_connection = True
         return True
 
+    def _chaos_partitioned(self) -> bool:
+        """Asymmetric-partition chaos site: while a client↔replica
+        partition window is open, EVERY request's connection is dropped
+        without a response — but the replica's store-side heartbeats
+        keep running (replica↔store alive), so its leases stay warm and
+        no failover fires.  Exactly the scenario where redirects and
+        client-side ring failover, not lease expiry, must carry the
+        traffic."""
+        monkey = _active_chaos()
+        if monkey is None or self.service.replica_set is None:
+            return False
+        rid = self.service.replica_set.replica_id
+        monkey.maybe_client_partition(rid)
+        if not monkey.client_partitioned(rid):
+            return False
+        logger.info("chaos: client partition drop (replica %s)", rid)
+        self.close_connection = True
+        return True
+
     # -- routes --------------------------------------------------------
     def do_GET(self):
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        if self._chaos_partitioned():
+            return
 
         def handle():
             if path == "/healthz":
@@ -210,6 +265,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, self.service.alerts())
             elif path == "/v1/warmup":
                 self._send(200, self.service.warmup_status())
+            elif path == "/v1/replicas":
+                self._send(200, self.service.replica_status())
             elif path == "/v1/studies":
                 self._send(200, {"studies": self.service.list_studies()})
             elif path.startswith("/v1/studies/"):
@@ -224,6 +281,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         path = self.path.split("?", 1)[0].rstrip("/")
+        if self._chaos_partitioned():
+            return
 
         def handle():
             # read the body FIRST on every route: an unread body left in

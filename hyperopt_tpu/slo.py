@@ -49,6 +49,9 @@ SL605     store_clean         zero torn journal lines / quarantined docs,
 SL606     fsync_latency       99% of storage-plane fsyncs ≤ bound_s
 SL607     cold_compile        ~zero compile-carrying suggests after ready
                               (the AOT-warmup closed-loop guard)
+SL608     failover_mttr       zero failed/slow replica takeovers (claim +
+                              fsck + recover + pre-warm within the MTTR
+                              bound; multi-replica mode only)
 ========  ==================  =============================================
 
 ``no_data`` (too few observations in a window) never breaches: silence
@@ -422,6 +425,46 @@ class ColdCompileRule(SloRule):
         )
 
 
+class FailoverMttrRule(SloRule):
+    """SL608: every replica takeover completes fast and clean — zero
+    failed takeovers and zero takeovers slower than the MTTR bound
+    (classified at record time by
+    :class:`~hyperopt_tpu.service.replicas.ReplicaStats` against its
+    ``mttr_bound_s``, default 30 s).  Zero-tolerance like SL605: the
+    burn IS the bad-takeover count.  A takeover's duration covers the
+    whole claim → fsck → recover → ledger-pre-warm pipeline, so a slow
+    one usually means the pre-warm degenerated into real cold compiles
+    — exactly the failover compile storm the ledger exists to pre-pay.
+    ``no_data`` on single-process deployments (no replica plane) and in
+    windows with no takeovers."""
+
+    rule_id = "SL608"
+    name = "failover_mttr"
+    description = (
+        "zero failed takeovers; every replica takeover (claim + fsck + "
+        "recover + pre-warm) within the MTTR bound"
+    )
+
+    def __init__(self, min_takeovers=1):
+        self.min_takeovers = int(min_takeovers)
+
+    def objective(self):
+        return {"budget": 0, "min_takeovers": self.min_takeovers}
+
+    def eval_window(self, win, absolute):
+        total = win.counter("replica_takeovers")
+        bad = (
+            win.counter("replica_takeovers_slow")
+            + win.counter("replica_takeovers_failed")
+        )
+        if total < self.min_takeovers and not bad:
+            return None, None, f"{total:g} takeover(s) in window"
+        return float(bad), bad, (
+            f"{bad:g}/{total:g} takeover(s) failed or exceeded the "
+            f"MTTR bound"
+        )
+
+
 def default_rules(**overrides) -> list:
     """The SL6xx catalog with default objectives.  ``overrides`` maps
     rule name → kwargs dict (e.g. ``latency_ratio={"ratio_max": 10}``)."""
@@ -433,6 +476,7 @@ def default_rules(**overrides) -> list:
         ("store_clean", StoreCleanRule),
         ("fsync_latency", FsyncLatencyRule),
         ("cold_compile", ColdCompileRule),
+        ("failover_mttr", FailoverMttrRule),
     )
     unknown = set(overrides) - {name for name, _ in builders}
     if unknown:
@@ -462,8 +506,8 @@ class SloEngine:
 
     # lock-order: _lock
     def __init__(self, service_stats=None, device_stats=None,
-                 store_stats=None, rules=None, recorder=None,
-                 fast_window=DEFAULT_FAST_WINDOW,
+                 store_stats=None, replica_stats=None, rules=None,
+                 recorder=None, fast_window=DEFAULT_FAST_WINDOW,
                  slow_window=DEFAULT_SLOW_WINDOW,
                  snapshot_interval=DEFAULT_SNAPSHOT_INTERVAL,
                  min_eval_interval=1.0, min_window_s=30.0,
@@ -473,6 +517,7 @@ class SloEngine:
         self.service_stats = service_stats
         self.device_stats = device_stats
         self.store_stats = store_stats
+        self.replica_stats = replica_stats
         self.rules = list(rules) if rules is not None else default_rules()
         self.recorder = recorder
         self.fast_window = float(fast_window)
@@ -511,6 +556,8 @@ class SloEngine:
             }
         if self.device_stats is not None:
             counters.update(self.device_stats.slo_counters())
+        if self.replica_stats is not None:
+            counters.update(self.replica_stats.slo_counters())
         if self.store_stats is not None:
             counters.update(self.store_stats.slo_counters())
             hists["fsync"] = self.store_stats.fsync_hist_state()

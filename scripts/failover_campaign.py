@@ -1,0 +1,591 @@
+"""Replica-plane chaos campaign: kill -9 the owner, prove warm failover.
+
+The ISSUE-13 acceptance run: N studies (default 8, each with its OWN
+program bucket via a distinct ``n_EI_candidates``) drive TWO replica
+server processes sharing one store root.  Mid-campaign the supervisor
+``kill -9``s the replica that owns the larger half of the studies; the
+survivor's failure detector claims the dead replica's leases after TTL
+expiry and takes each study over **claim → fsck-clean → recover →
+ledger pre-warm → serve**.  Clients ride through on consistent-hash
+routing + ring failover + idempotent retries.  The campaign asserts:
+
+1. every study the victim owned migrates to the survivor, every
+   takeover record is ``ok`` with ``fsck_clean`` true;
+2. the migrated studies' FIRST post-failover suggests hit **zero
+   request-path compiles** on the survivor (the shared compile ledger
+   + dry prepare probes pre-warmed their program grid before cutover;
+   proven by the survivor's cold-suggest counters, sampled around a
+   quiescent probe window in which ONLY those first suggests run);
+3. zero lost or duplicated trials, and every study's ``vals``
+   trajectory is trial-for-trial identical to a fault-free
+   single-replica twin at the same seeds (exactly-once across the
+   migration);
+4. a final ``fsck`` pass reports the shared store clean (the FS409
+   lease rules included).
+
+The kill POINT is armed by the seeded ``replica_kill`` chaos site —
+one roll per completed pre-phase trial against the current owner — and
+executed at the pre-phase barrier: the probe window must be quiescent
+so the cold-counter delta is attributable to the migrated studies'
+first suggests alone.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/failover_campaign.py \
+        [--studies 8] [--pre 6] [--post 5] [--seed 0] [--quick] \
+        [--ttl 2.0] [--out FAILOVER_SERVE.json]
+
+Exit code 0 iff every assertion held.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _space():
+    from hyperopt_tpu import hp
+
+    return {
+        "x": hp.uniform("x", -5, 5),
+        "lr": hp.loguniform("lr", -5, 0),
+        "c": hp.choice("c", ["a", "b", "d"]),
+    }
+
+
+def _objective(point):
+    """Pure function of the point — the chaos run and the fault-free
+    twin must compute identical losses for identical suggestions."""
+    return (
+        (point["x"] - 1.0) ** 2
+        + (np.log(point["lr"]) + 2.0) ** 2
+        + (0.5 if point["c"] == "b" else 0.0)
+    )
+
+
+def _study_seed(seed, idx):
+    return seed * 1000 + idx
+
+
+def _study_params(idx):
+    """Every study gets its OWN program bucket (a distinct candidate
+    count): the survivor never compiled a victim study's program while
+    serving its own tenants, so a warm first post-failover suggest is
+    evidence of the ledger pre-warm, not of bucket sharing."""
+    return {"n_startup_jobs": 3, "n_EI_candidates": 8 * (idx + 1)}
+
+
+# ---------------------------------------------------------------------
+# fault-free twin (one in-process service, no HTTP, no replicas)
+# ---------------------------------------------------------------------
+
+def run_twin(study_ids, n_trials, seed):
+    """Per-study vals trajectories of the uninterrupted single-replica
+    run at the same seeds and algo params."""
+    from hyperopt_tpu.fmin import space_eval
+    from hyperopt_tpu.service import OptimizationService
+
+    space = _space()
+    svc = OptimizationService(root=None, batch_window=0.001)
+    out = {}
+    try:
+        for i, sid in enumerate(study_ids):
+            svc.create_study(sid, space, seed=_study_seed(seed, i),
+                             algo="tpe", algo_params=_study_params(i))
+            traj = []
+            for _ in range(n_trials):
+                (t,) = svc.suggest(sid)
+                traj.append(t["vals"])
+                point = space_eval(space, t["vals"])
+                svc.report(sid, t["tid"], loss=_objective(point))
+            out[sid] = traj
+    finally:
+        svc.close()
+    return out
+
+
+def _spread_study_ids(urls, n_studies):
+    """Study ids whose consistent-hash primaries split evenly across
+    the replicas.  The ring is deterministic in the URL set alone, so
+    the campaign — like every client — computes the split with zero
+    coordination; picking names BY the ring removes the (small) chance
+    a fixed name set lands every study on one replica."""
+    from hyperopt_tpu.service.replicas import HashRing
+
+    ring = HashRing(urls)
+    want = {u: n_studies // len(urls) for u in urls}
+    spare = n_studies - sum(want.values())
+    names, i = [], 0
+    while len(names) < n_studies:
+        sid = f"fo-{i}"
+        i += 1
+        primary = ring.primary(sid)
+        if want.get(primary, 0) > 0:
+            want[primary] -= 1
+            names.append(sid)
+        elif spare > 0:
+            spare -= 1
+            names.append(sid)
+        if i > 10_000:
+            raise RuntimeError("ring never covered the even split")
+    return names
+
+
+# ---------------------------------------------------------------------
+# replica process management
+# ---------------------------------------------------------------------
+
+class Replica:
+    """One replica server subprocess on the shared root."""
+
+    def __init__(self, root, replica_id, port, ttl, log_dir):
+        self.root = root
+        self.replica_id = replica_id
+        self.port = port
+        self.ttl = ttl
+        self.log_dir = log_dir
+        self.proc = None
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}"
+
+    def _env(self):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [REPO] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+        )
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        return env
+
+    def start(self, wait_ready_timeout=300.0):
+        from hyperopt_tpu.service import ServiceClient
+
+        log = open(os.path.join(
+            self.log_dir, f"{self.replica_id}.log"), "wb")
+        self.proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "hyperopt_tpu.service",
+                "--root", self.root,
+                "--port", str(self.port),
+                "--replica-id", self.replica_id,
+                "--advertise-url", self.url,
+                "--replica-ttl", str(self.ttl),
+                "--batch-window", "0.002",
+                # the persistent XLA cache can load an executable whose
+                # low-bit numerics differ from a fresh in-process
+                # compile, flipping near-tie EI winners — with two
+                # replicas sharing the cache dir, WHICH replica
+                # compiled a program first would decide the other's
+                # numerics.  The twin comparison needs fresh-compile
+                # numerics everywhere; the compile LEDGER (not the XLA
+                # cache) is what the takeover pre-warm replays, so the
+                # warm-failover proof is unaffected.
+                "--compile-cache-dir", "none",
+                "--log-level", "INFO",
+            ],
+            env=self._env(), cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=log,
+        )
+        client = ServiceClient(self.url, timeout=30)
+        return client.wait_ready(timeout=wait_ready_timeout)
+
+    def kill9(self):
+        if self.proc is None or self.proc.poll() is not None:
+            return False
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=30)
+        return True
+
+    def stop(self, timeout=60.0):
+        if self.proc is None or self.proc.poll() is not None:
+            return
+        self.proc.send_signal(signal.SIGTERM)
+        try:
+            self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------
+# the campaign
+# ---------------------------------------------------------------------
+
+def _fleet_client(urls, seed, idx, phase):
+    from hyperopt_tpu.service import ServiceClient
+
+    return ServiceClient(
+        replicas=urls,
+        timeout=60,
+        deadline=300.0,
+        retry_timeout=300.0,
+        backoff_base=0.05,
+        backoff_max=1.0,
+        jitter=0.2,
+        retry_seed=seed,
+        breaker_threshold=4,
+        breaker_cooldown=0.5,
+        # unique per (study, phase): a fresh client restarts its key
+        # sequence, and the journal rejects cross-route key reuse
+        idempotency_prefix=f"fo{idx}-{phase}",
+    )
+
+
+def _drive_phase(urls, study_ids, n_trials, seed, space, errors):
+    """Drive every study ``n_trials`` further, one client thread each
+    (the concurrent-tenant shape), joining at a barrier."""
+    from hyperopt_tpu.fmin import space_eval
+
+    def drive(idx, sid):
+        try:
+            client = _fleet_client(urls, seed, idx, "pre")
+            for _ in range(n_trials):
+                (t,) = client.suggest(sid)
+                point = space_eval(space, t["vals"])
+                client.report(sid, t["tid"], loss=_objective(point))
+        except Exception as e:
+            errors.append(f"{sid}: {e!r}")
+
+    threads = [
+        threading.Thread(target=drive, args=(i, sid), daemon=True)
+        for i, sid in enumerate(study_ids)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=1200)
+    stuck = [t for t in threads if t.is_alive()]
+    if stuck:
+        errors.append(f"{len(stuck)} study clients timed out")
+
+
+def _owned_studies(url):
+    from hyperopt_tpu.service import ServiceClient
+
+    doc = ServiceClient(url, deadline=60.0).replicas()
+    return doc.get("owned_studies", []), doc
+
+
+def _cold_counters(url):
+    from hyperopt_tpu.service import ServiceClient
+
+    stats = ServiceClient(url, deadline=60.0).service_status()["stats"]
+    return {
+        "n_cold_suggests": stats["n_cold_suggests"],
+        "n_cold_after_ready": stats["n_cold_after_ready"],
+    }
+
+
+def run_campaign(n_studies=8, n_pre=6, n_post=5, seed=0, ttl=2.0,
+                 root=None, quick=False):
+    from hyperopt_tpu.fmin import space_eval
+    from hyperopt_tpu.resilience.chaos import ChaosConfig, ChaosMonkey
+    from hyperopt_tpu.resilience.fsck import fsck_path
+    from hyperopt_tpu.service import free_port
+
+    if quick:
+        n_pre, n_post = min(n_pre, 4), min(n_post, 3)
+    space = _space()
+    n_trials = n_pre + n_post
+    t0 = time.time()
+    errors = []
+
+    if root is None:
+        root = tempfile.mkdtemp(prefix="failover_serve_")
+    os.makedirs(root, exist_ok=True)
+    replicas = [
+        Replica(root, "r1", free_port(), ttl, root),
+        Replica(root, "r2", free_port(), ttl, root),
+    ]
+    for r in replicas:
+        r.start()
+    urls = [r.url for r in replicas]
+    study_ids = _spread_study_ids(urls, n_studies)
+
+    twin = run_twin(study_ids, n_trials, seed)
+
+    # the seeded owning-replica SIGKILL site: one roll per completed
+    # pre-phase trial against the current owner arms the kill, which
+    # executes at the pre-phase barrier (the first-suggest probe window
+    # must be quiescent so the survivor's cold-counter delta is
+    # attributable to the migrated studies alone)
+    monkey = ChaosMonkey(ChaosConfig(seed=seed, p_replica_kill=0.25))
+
+    try:
+        # -- create + pre phase ----------------------------------------
+        for i, sid in enumerate(study_ids):
+            _fleet_client(urls, seed, i, "create").create_study(
+                sid, space, seed=_study_seed(seed, i),
+                algo="tpe", algo_params=_study_params(i), exist_ok=True,
+            )
+        owned = {r.replica_id: _owned_studies(r.url)[0] for r in replicas}
+        campaign_owned = {
+            rid: sorted(set(sids) & set(study_ids))
+            for rid, sids in owned.items()
+        }
+        victim = max(
+            replicas, key=lambda r: len(campaign_owned[r.replica_id])
+        )
+        survivor = next(r for r in replicas if r is not victim)
+
+        _drive_phase(urls, study_ids, n_pre, seed, space, errors)
+        kill_rolls = sum(
+            1 for _ in range(n_studies * n_pre)
+            if monkey.should_kill_replica(victim.replica_id)
+        )
+
+        # -- the kill --------------------------------------------------
+        victim_owned = sorted(
+            set(_owned_studies(victim.url)[0]) & set(study_ids)
+        )
+        cold_before = _cold_counters(survivor.url)
+        takeovers_before = len(
+            _owned_studies(survivor.url)[1]["stats"]["recent_takeovers"]
+        )
+        if kill_rolls == 0:
+            # the docstring's contract: the kill POINT is armed by the
+            # seeded replica_kill site.  At p=0.25 over studies*pre
+            # rolls this is a ~1e-6 branch — but if it happens, failing
+            # honestly beats killing a replica no roll armed.
+            errors.append(
+                "seeded replica_kill site fired 0 rolls; kill not armed"
+            )
+        killed = victim.kill9() if kill_rolls > 0 else False
+        t_kill = time.time()
+
+        # -- first-suggest probe window (quiescent): ONE suggest+report
+        # per migrated study, serially, through the failover client ----
+        first_suggest = {}
+        for sid in victim_owned:
+            idx = study_ids.index(sid)
+            client = _fleet_client(urls, seed, idx, "probe")
+            t1 = time.monotonic()
+            (t,) = client.suggest(sid)
+            first_suggest[sid] = round(time.monotonic() - t1, 3)
+            point = space_eval(space, t["vals"])
+            client.report(sid, t["tid"], loss=_objective(point))
+        mttr_s = round(time.time() - t_kill, 2)
+        cold_after = _cold_counters(survivor.url)
+        survivor_owned_now, survivor_doc = _owned_studies(survivor.url)
+
+        # -- post phase: the remaining trials (migrated studies already
+        # spent one on the probe), every study concurrent again --------
+        remaining = {
+            sid: n_post - (1 if sid in victim_owned else 0)
+            for sid in study_ids
+        }
+
+        def drive_rest(idx, sid):
+            try:
+                client = _fleet_client(urls, seed, idx, "post")
+                for _ in range(remaining[sid]):
+                    (t,) = client.suggest(sid)
+                    point = space_eval(space, t["vals"])
+                    client.report(sid, t["tid"], loss=_objective(point))
+            except Exception as e:
+                errors.append(f"{sid}: {e!r}")
+
+        threads = [
+            threading.Thread(
+                target=drive_rest, args=(i, sid), daemon=True
+            )
+            for i, sid in enumerate(study_ids)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=1200)
+        if any(th.is_alive() for th in threads):
+            errors.append("post-phase study clients timed out")
+
+        # -- reconcile -------------------------------------------------
+        final_owned, final_doc = _owned_studies(survivor.url)
+        takeover_records = [
+            rec for rec in
+            final_doc["stats"]["recent_takeovers"][takeovers_before:]
+            if rec["study_id"] in study_ids
+        ]
+        migrated = sorted(
+            set(victim_owned) & set(final_owned)
+        )
+        cold_delta = {
+            k: cold_after[k] - cold_before[k] for k in cold_before
+        }
+    finally:
+        for r in replicas:
+            r.stop()
+
+    fsck_repair = fsck_path(root, repair=True).summary()
+    fsck_verify = fsck_path(root, repair=False).summary()
+    integrity, trajectories_match = _verify_store(
+        root, twin, study_ids, n_trials
+    )
+
+    by_study = {rec["study_id"]: rec for rec in takeover_records}
+    takeovers_ok = bool(victim_owned) and all(
+        by_study.get(sid, {}).get("ok") is True
+        and by_study.get(sid, {}).get("fsck_clean") is True
+        for sid in victim_owned
+    )
+    prewarm = {"warm": 0, "skipped": 0, "error": 0, "pending": 0,
+               "compiling": 0}
+    for rec in takeover_records:
+        for k, v in (rec.get("prewarm") or {}).items():
+            prewarm[k] = prewarm.get(k, 0) + int(v)
+
+    ok = (
+        not errors
+        and killed
+        and migrated == victim_owned
+        and takeovers_ok
+        and prewarm["error"] == 0
+        and cold_delta["n_cold_suggests"] == 0
+        and cold_delta["n_cold_after_ready"] == 0
+        and integrity["lost_trials"] == 0
+        and integrity["duplicated_trials"] == 0
+        and trajectories_match
+        and fsck_verify["clean"]
+    )
+    return {
+        "campaign": "failover_serve",
+        "ok": ok,
+        "quick": quick,
+        "seed": seed,
+        "n_studies": n_studies,
+        "study_ids": study_ids,
+        "n_replicas": len(replicas),
+        "n_trials_per_study": n_trials,
+        "n_pre": n_pre,
+        "n_post": n_post,
+        "replica_ttl_s": ttl,
+        "elapsed_s": round(time.time() - t0, 2),
+        "errors": errors,
+        "ownership_before_kill": campaign_owned,
+        "victim": victim.replica_id,
+        "survivor": survivor.replica_id,
+        "victim_killed": killed,
+        "kill_site_rolls_hit": kill_rolls,
+        "victim_owned": victim_owned,
+        "migrated": migrated,
+        "n_migrated": len(migrated),
+        "takeovers": takeover_records,
+        "all_takeovers_ok_and_fsck_clean": takeovers_ok,
+        "prewarm": prewarm,
+        "first_suggest_s": first_suggest,
+        "migration_window_s": mttr_s,
+        "cold_suggest_delta_over_probe_window": cold_delta,
+        "integrity": integrity,
+        "trajectories_match_fault_free": trajectories_match,
+        "fsck_after_repair": {
+            k: v for k, v in fsck_verify.items() if k != "findings"
+        },
+        "fsck_repairs": fsck_repair["by_rule"],
+        "root": root,
+    }
+
+
+def _verify_store(root, twin, study_ids, n_trials):
+    """Read every study's docs off disk (post-fsck) and check the
+    zero-lost/zero-duplicated and trajectory-identity invariants."""
+    from hyperopt_tpu.base import JOB_STATE_DONE
+    from hyperopt_tpu.parallel.file_trials import FileTrials
+
+    lost = dup = incomplete = 0
+    mismatched = []
+    for sid in study_ids:
+        qdir = os.path.join(root, "studies", sid)
+        trials = FileTrials(qdir)
+        docs = sorted(
+            trials._dynamic_trials, key=lambda d: int(d["tid"])
+        )
+        tids = [int(d["tid"]) for d in docs]
+        if len(set(tids)) != len(tids):
+            dup += len(tids) - len(set(tids))
+        if len(docs) < n_trials:
+            lost += n_trials - len(docs)
+        if len(docs) > n_trials:
+            dup += len(docs) - n_trials
+        incomplete += sum(
+            1 for d in docs if d["state"] != JOB_STATE_DONE
+        )
+        got = [
+            {
+                label: v[0]
+                for label, v in d["misc"]["vals"].items() if len(v)
+            }
+            for d in docs
+        ]
+        want = twin[sid]
+        if len(got) != len(want) or any(
+            g.keys() != w.keys()
+            or any(not np.isclose(g[k], w[k]) for k in g)
+            for g, w in zip(got, want)
+        ):
+            mismatched.append(sid)
+    return (
+        {
+            "lost_trials": lost,
+            "duplicated_trials": dup,
+            "incomplete_trials": incomplete,
+            "mismatched_studies": mismatched,
+        },
+        not mismatched and incomplete == 0,
+    )
+
+
+def write_report(report, out_path):
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1, default=str)
+        f.write("\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--studies", type=int, default=8)
+    ap.add_argument("--pre", type=int, default=6,
+                    help="trials per study before the kill")
+    ap.add_argument("--post", type=int, default=5,
+                    help="trials per study after the kill")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--ttl", type=float, default=2.0,
+                    help="replica lease TTL (failover detection time)")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI smoke config (caps pre/post at 4/3)")
+    ap.add_argument(
+        "--out",
+        default=os.path.join(REPO, "FAILOVER_SERVE.json"),
+    )
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    report = run_campaign(
+        n_studies=args.studies,
+        n_pre=args.pre,
+        n_post=args.post,
+        seed=args.seed,
+        ttl=args.ttl,
+        quick=args.quick,
+    )
+    print(json.dumps(report, indent=1, default=str))
+    if args.out:
+        write_report(report, args.out)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,65 @@
+"""Subprocess body for the cross-process replica-lease contention
+tests (tests/test_resilience.py::TestReplicaLeases).
+
+Modes:
+
+- ``race``: park until the parent drops a ``go`` file, then attempt ONE
+  claim — two of these started together are a real two-process race on
+  the ``O_CREAT|O_EXCL`` claim lock.
+- ``cycle N``: N claim→release cycles, spinning while the peer holds
+  the lease; prints the fence sequence this process observed.
+
+Prints one JSON line on stdout; exit 0 on success.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main():
+    root, study, rid, mode = sys.argv[1:5]
+    from hyperopt_tpu.service.replicas import StudyLeaseStore
+
+    store = StudyLeaseStore(root, ttl=5.0)
+    if mode == "race":
+        go = os.path.join(root, "go")
+        deadline = time.time() + 30.0
+        while not os.path.exists(go):
+            if time.time() > deadline:
+                print(json.dumps({"replica": rid, "error": "timeout"}))
+                return 1
+            time.sleep(0.001)
+        fence = store.claim(study, rid)
+        print(json.dumps({"replica": rid, "fence": fence}))
+        return 0
+    if mode == "cycle":
+        n = int(sys.argv[5])
+        fences = []
+        deadline = time.time() + 60.0
+        for _ in range(n):
+            fence = None
+            while fence is None:
+                fence = store.claim(study, rid)
+                if fence is None:
+                    if time.time() > deadline:
+                        print(json.dumps(
+                            {"replica": rid, "error": "starved"}
+                        ))
+                        return 1
+                    time.sleep(0.002)
+            fences.append(fence)
+            store.release(study, rid, fence)
+        print(json.dumps({"replica": rid, "fences": fences}))
+        return 0
+    print(json.dumps({"replica": rid, "error": f"bad mode {mode}"}))
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

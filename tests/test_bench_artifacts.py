@@ -494,3 +494,77 @@ def test_device_profile_artifact_fully_attributed():
     # observers-disabled overhead: suggest p50 within 5%
     if d.get("overhead"):
         assert d["overhead"]["p50_regression_frac"] < 0.05
+
+
+# ---------------------------------------------------------------------
+# FAILOVER_SERVE.json — the ISSUE-13 replica-plane failover artifact
+# ---------------------------------------------------------------------
+
+FAILOVER_SERVE = os.path.join(ROOT, "FAILOVER_SERVE.json")
+
+
+@pytest.mark.skipif(
+    not os.path.exists(FAILOVER_SERVE),
+    reason="no committed failover artifact",
+)
+def test_failover_serve_artifact_proves_warm_takeover():
+    """The ISSUE-13 acceptance artifact: >=8 studies across >=2
+    replicas, the owning replica kill -9'd mid-campaign, every study
+    it owned migrated after an ok + fsck-clean takeover, the migrated
+    studies' first post-failover suggests hit ZERO request-path
+    compiles (ledger pre-warm, proven by the survivor's cold-suggest
+    counter deltas over the quiescent probe window), zero
+    lost/duplicated trials, and every trajectory trial-for-trial
+    identical to the fault-free single-replica twin.  Every guard is
+    STRUCTURAL (counts/ratios/coverage) — never absolute milliseconds:
+    sandbox latency swings ~30x between sessions."""
+    d = _load(FAILOVER_SERVE)
+    assert d["campaign"] == "failover_serve"
+    assert d["ok"] is True
+    # the committed artifact is the FULL capture (a quick smoke writes
+    # FAILOVER_SERVE.quick.json and must never clobber this one)
+    assert d["quick"] is False
+    assert d["errors"] == []
+    # scale floor: the acceptance's >=8 studies across >=2 replicas
+    assert d["n_studies"] >= 8
+    assert d["n_replicas"] >= 2
+    assert len(d["study_ids"]) == d["n_studies"]
+    # before the kill, BOTH replicas owned campaign studies (the
+    # consistent-hash spread), and together they owned all of them
+    owned = d["ownership_before_kill"]
+    assert len(owned) == d["n_replicas"]
+    assert all(owned.values())
+    assert sorted(
+        sid for sids in owned.values() for sid in sids
+    ) == sorted(d["study_ids"])
+    # the owner died for real, and every study it owned migrated
+    assert d["victim_killed"] is True
+    assert d["victim_owned"]
+    assert d["migrated"] == d["victim_owned"]
+    assert d["n_migrated"] == len(d["victim_owned"])
+    # every takeover ok + fsck-clean, each migrated study accounted for
+    assert d["all_takeovers_ok_and_fsck_clean"] is True
+    by_study = {t["study_id"]: t for t in d["takeovers"]}
+    for sid in d["victim_owned"]:
+        rec = by_study[sid]
+        assert rec["ok"] is True
+        assert rec["fsck_clean"] is True
+        assert rec["from_owner"] == d["victim"]
+        assert rec["fence"] >= 1
+    # warm failover: the pre-warm did real work with zero errors, and
+    # the first post-failover suggests paid ZERO request-path compiles
+    assert d["prewarm"]["error"] == 0
+    assert d["prewarm"]["warm"] + d["prewarm"]["skipped"] >= 1
+    cold = d["cold_suggest_delta_over_probe_window"]
+    assert cold["n_cold_suggests"] == 0
+    assert cold["n_cold_after_ready"] == 0
+    # one first-suggest sample per migrated study was actually taken
+    assert sorted(d["first_suggest_s"]) == sorted(d["victim_owned"])
+    # exactly-once across the migration
+    integ = d["integrity"]
+    assert integ["lost_trials"] == 0
+    assert integ["duplicated_trials"] == 0
+    assert integ["incomplete_trials"] == 0
+    assert integ["mismatched_studies"] == []
+    assert d["trajectories_match_fault_free"] is True
+    assert d["fsck_after_repair"]["clean"] is True

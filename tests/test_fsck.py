@@ -338,3 +338,142 @@ class TestTmpDroppingGC:
         reaper.reap_once()
         assert not os.path.exists(old)
         assert stats.get("tmp_dropping_cleared") == 1
+
+
+# ---------------------------------------------------------------------
+# FS409: replica-plane leases / fences / claim locks / registry records
+# ---------------------------------------------------------------------
+
+class TestFS409ReplicaPlane:
+    def _root_with_study(self, tmp_path, study="st"):
+        from hyperopt_tpu.service.replicas import StudyLeaseStore
+
+        root = str(tmp_path / "root")
+        os.makedirs(os.path.join(root, "studies", study, "trials"))
+        store = StudyLeaseStore(root, ttl=0.2)
+        return root, store, study
+
+    def _rules(self, report):
+        return [f.rule for f in report.findings]
+
+    def test_orphan_lease_deleted(self, tmp_path):
+        import time as _time
+
+        root, store, _ = self._root_with_study(tmp_path)
+        store.claim("ghost", "r1")  # no studies/ghost directory
+        # while the lease is LIVE this is the mid-create window
+        # (ownership-before-side-effects): not damage, not even a
+        # finding — deleting it would steal a live creator's lease and
+        # reset its fence
+        report = fsck_path(root, repair=True)
+        assert "FS409" not in self._rules(report)
+        assert os.path.exists(store.lease_path("ghost"))
+        assert os.path.exists(store.fence_path("ghost"))
+        _time.sleep(0.3)  # past the TTL: a crashed creator's residue
+        report = fsck_path(root, repair=True)
+        assert "FS409" in self._rules(report)
+        assert report.clean
+        assert not os.path.exists(store.lease_path("ghost"))
+        assert not os.path.exists(store.fence_path("ghost"))
+
+    def test_expired_lease_reclaimed_fence_preserved(self, tmp_path):
+        import time as _time
+
+        root, store, study = self._root_with_study(tmp_path)
+        f1 = store.claim(study, "dead-replica")
+        _time.sleep(0.3)  # expired — but within one TTL of grace: a
+        # briefly-stalled holder may still renew, so fsck leaves it
+        report = fsck_path(root, repair=True)
+        assert "FS409" not in self._rules(report)
+        assert store.read(study)["owner"] == "dead-replica"
+        _time.sleep(0.2)  # past the grace too: dead owner's residue
+        report = fsck_path(root, repair=True)
+        assert "FS409" in self._rules(report)
+        assert report.clean
+        lease = store.read(study)
+        assert lease["owner"] is None
+        assert int(lease["fence"]) == f1  # preserved, not reset
+        # the dead owner's credential stays dead; a new claim bumps
+        assert not store.verify(study, "dead-replica", f1)
+        assert store.claim(study, "r2") == f1 + 1
+
+    def test_torn_lease_quarantined(self, tmp_path):
+        root, store, study = self._root_with_study(tmp_path)
+        store.claim(study, "r1")
+        path = store.lease_path(study)
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) // 2)
+        report = fsck_path(root, repair=True)
+        assert "FS409" in self._rules(report)
+        assert report.clean
+        assert not os.path.exists(path)
+        assert any(
+            n.startswith(os.path.basename(path) + ".corrupt")
+            or n == os.path.basename(path) + ".corrupt"
+            for n in os.listdir(os.path.dirname(path))
+        )
+
+    def test_garbled_fence_counter_rewritten_past_evidence(self, tmp_path):
+        root, store, study = self._root_with_study(tmp_path)
+        fence = store.claim(study, "r1")
+        with open(store.fence_path(study), "w") as f:
+            f.write("not-a-number")
+        report = fsck_path(root, repair=True)
+        assert "FS409" in self._rules(report)
+        assert report.clean
+        assert store.read_fence(study) == fence + 1
+
+    def test_stuck_claimlock_cleared(self, tmp_path):
+        import time as _time
+
+        root, store, study = self._root_with_study(tmp_path)
+        lock = store._claim_lock_path(study)
+        with open(lock, "w") as f:
+            f.write("")
+        # a FRESH lock may be a live peer inside the claim critical
+        # section (a sibling's startup fsck runs against a live root):
+        # untouched, no finding
+        report = fsck_path(root, repair=True)
+        assert "FS409" not in self._rules(report)
+        assert os.path.exists(lock)
+        # backdated past the grace: a claimant killed mid-claim
+        old = _time.time() - 120.0
+        os.utime(lock, (old, old))
+        report = fsck_path(root, repair=True)
+        assert "FS409" in self._rules(report)
+        assert report.clean
+        assert not os.path.exists(lock)
+
+    def test_torn_registry_record_deleted(self, tmp_path):
+        from hyperopt_tpu.service.replicas import ReplicaDirectory
+
+        root = str(tmp_path / "root")
+        directory = ReplicaDirectory(root)
+        directory.advertise("r1", "http://127.0.0.1:1")
+        path = directory.record_path("r1")
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) // 2)
+        report = fsck_path(root, repair=True)
+        assert "FS409" in self._rules(report)
+        assert report.clean
+        assert not os.path.exists(path)
+
+    def test_live_plane_untouched_and_dry_run_reports_only(self, tmp_path):
+        import time as _time
+
+        root, store, study = self._root_with_study(tmp_path)
+        f1 = store.claim(study, "r1")
+        store.claim("ghost", "r2")
+        _time.sleep(0.3)  # ghost orphan past TTL; study lease expired
+        # but inside the reclaim grace (still safely r1's)
+        # dry run: finds the orphan, repairs nothing
+        report = fsck_path(root, repair=False)
+        assert "FS409" in self._rules(report)
+        assert not report.clean
+        assert os.path.exists(store.lease_path("ghost"))
+        # the LIVE lease is never flagged
+        assert not any(
+            os.path.basename(f.path).startswith(study + ".")
+            for f in report.findings if f.rule == "FS409"
+        )
+        assert store.verify(study, "r1", f1)

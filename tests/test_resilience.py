@@ -987,3 +987,157 @@ def test_resilience_package_passes_race_lint():
     }
     diags = lint_races(paths)
     assert diags == [], [d.format() for d in diags]
+
+
+# ---------------------------------------------------------------------
+# replica-plane ownership leases (ISSUE 13 satellite): cross-process
+# claim contention, fencing-token monotonicity, stale-write drops
+# ---------------------------------------------------------------------
+
+_HELPER = os.path.join(os.path.dirname(__file__), "replica_lease_helper.py")
+
+
+def _spawn_helper(*args):
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen(
+        [sys.executable, _HELPER, *map(str, args)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+    )
+
+
+class TestReplicaLeases:
+    def test_cross_process_claim_race_has_one_winner(self, tmp_path):
+        """Two REAL processes race one claim on the same study: the
+        O_CREAT|O_EXCL claim lock must admit exactly one winner."""
+        import json
+
+        root = str(tmp_path)
+        procs = [
+            _spawn_helper(root, "contested", f"racer-{i}", "race")
+            for i in range(2)
+        ]
+        time.sleep(1.0)  # both parked on the go file (imports done)
+        with open(os.path.join(root, "go"), "w") as f:
+            f.write("go")
+        results = []
+        for p in procs:
+            out, err = p.communicate(timeout=60)
+            assert p.returncode == 0, err.decode()
+            results.append(json.loads(out.decode().strip()))
+        fences = [r["fence"] for r in results]
+        winners = [f for f in fences if f is not None]
+        assert len(winners) == 1, results
+        assert winners[0] == 1
+
+    def test_fencing_tokens_monotonic_across_processes(self, tmp_path):
+        """Two processes interleaving claim→release cycles: every claim
+        bumps the fence, no token is ever reused, and each process sees
+        a strictly increasing sequence."""
+        import json
+
+        root = str(tmp_path)
+        n = 5
+        procs = [
+            _spawn_helper(root, "shared", f"cycler-{i}", "cycle", n)
+            for i in range(2)
+        ]
+        all_fences = []
+        for p in procs:
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, err.decode()
+            rec = json.loads(out.decode().strip())
+            assert "fences" in rec, rec
+            seq = rec["fences"]
+            assert seq == sorted(seq) and len(set(seq)) == len(seq), seq
+            all_fences.extend(seq)
+        # no reuse across processes, and every claim bumped exactly once
+        assert len(set(all_fences)) == 2 * n, all_fences
+        assert max(all_fences) == 2 * n
+
+    def test_expired_lease_is_reclaimable_and_old_fence_dies(self, tmp_path):
+        from hyperopt_tpu.service.replicas import StudyLeaseStore
+
+        store = StudyLeaseStore(str(tmp_path), ttl=0.2)
+        f1 = store.claim("s", "r1")
+        assert f1 == 1
+        # frozen holder: no renewals past the TTL
+        time.sleep(0.3)
+        f2 = store.claim("s", "r2")
+        assert f2 == 2
+        # the resumed holder's credential is dead: verify fails, renew
+        # fails, and a re-claim while r2 is live fails
+        assert not store.verify("s", "r1", f1)
+        assert not store.renew("s", "r1", f1)
+        assert store.claim("s", "r1") is None
+        # r2's own credential is current
+        assert store.verify("s", "r2", f2)
+
+    def test_torn_lease_never_resets_the_fence(self, tmp_path):
+        """A torn lease file reads as 'no grant' but the separate fence
+        counter keeps tokens monotonic — the stale holder still loses."""
+        from hyperopt_tpu.service.replicas import StudyLeaseStore
+
+        store = StudyLeaseStore(str(tmp_path), ttl=60.0)
+        f1 = store.claim("s", "r1")
+        # tear the lease file in place (lying-disk model)
+        path = store.lease_path("s")
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) // 2)
+        assert store.read("s") is None
+        f2 = store.claim("s", "r2")
+        assert f2 == f1 + 1  # the fence counter survived the tear
+        assert not store.verify("s", "r1", f1)
+
+    def test_stale_fenced_terminal_write_dropped_end_to_end(self, tmp_path):
+        """The PR 3 owner-re-verify discipline one level up: a frozen
+        replica whose study was reclaimed has its terminal report
+        DROPPED at the commit-time fence verify — nothing lands in the
+        journal or the store, and the service redirects."""
+        from hyperopt_tpu.service import NotOwner, OptimizationService
+
+        root = str(tmp_path / "root")
+        algo_params = {"n_startup_jobs": 2, "n_EI_candidates": 8}
+        s1 = OptimizationService(
+            root=root, replica_id="r1", advertise_url="http://r1",
+            replica_ttl=0.4, batch_window=0.001, warmup=False,
+        )
+        s2 = None
+        try:
+            s1.create_study("mig", SPACE, seed=3, algo="tpe",
+                            algo_params=algo_params)
+            (t1,) = s1.suggest("mig")
+            s1.report("mig", t1["tid"], loss=1.0)
+            (t2,) = s1.suggest("mig")
+            # freeze r1: heartbeats stop, lease left in place to expire
+            s1.replica_set._stop.set()
+            time.sleep(0.6)
+            # r2 starts on the shared root and reclaims the study at
+            # startup recovery (expired lease -> bumped fence)
+            s2 = OptimizationService(
+                root=root, replica_id="r2", advertise_url="http://r2",
+                replica_ttl=0.4, batch_window=0.001, warmup=False,
+            )
+            assert "mig" in s2.registry.list()
+            h1 = s1.replica_set.handle_of("mig")
+            h2 = s2.replica_set.handle_of("mig")
+            assert h2.fence > h1.fence
+            # the frozen replica resumes and tries to land t2's loss:
+            # dropped BEFORE any journal/store mutation, and the
+            # service answers NotOwner (the 307/503 shape)
+            with pytest.raises(NotOwner):
+                s1.report("mig", t2["tid"], loss=0.5)
+            assert s1.replica_set.stats.get("stale_write_dropped") >= 1
+            # nothing landed: r2's copy of t2 is still un-reported
+            status = s2.study_status("mig")
+            assert status["n_completed"] == 1
+            # ... and r2 lands it fine (the client's retry path)
+            s2.report("mig", t2["tid"], loss=0.5)
+            assert s2.study_status("mig")["n_completed"] == 2
+        finally:
+            s1.close()
+            if s2 is not None:
+                s2.close()

@@ -20,6 +20,7 @@ Covers the ISSUE 4 contract:
 - ServiceStats accounting and the Prometheus text renderer.
 """
 
+import json
 import os
 import pickle
 import signal
@@ -89,10 +90,10 @@ def _drive(svc, study_id, n, objective=_objective):
     return out
 
 
-def _serial_fmin_vals(seed, max_evals):
+def _serial_fmin_vals(seed, max_evals, ap=AP):
     trials = Trials()
     fmin(
-        _objective, SPACE, algo=partial(tpe.suggest, **AP),
+        _objective, SPACE, algo=partial(tpe.suggest, **ap),
         max_evals=max_evals, trials=trials,
         rstate=np.random.default_rng(seed), show_progressbar=False,
         verbose=False, max_speculation=0,
@@ -1342,3 +1343,464 @@ class TestRenderPrometheus:
 
     def test_empty_render(self):
         assert render_prometheus() == "\n"
+
+
+# ---------------------------------------------------------------------
+# multi-replica serving (ISSUE 13): leased ownership, routing, failover
+# ---------------------------------------------------------------------
+
+RAP = {"n_startup_jobs": 2, "n_EI_candidates": 16}
+
+
+def _replica_pair(root, ttl=0.5, **kw):
+    """Two live server processes... in-process: two OptimizationService
+    + ServiceServer pairs sharing one root, with pre-allocated ports so
+    the advertise URLs are known at construction."""
+    from hyperopt_tpu.service import free_port
+
+    p1, p2 = free_port(), free_port()
+    u1 = f"http://127.0.0.1:{p1}"
+    u2 = f"http://127.0.0.1:{p2}"
+    s1 = OptimizationService(
+        root=root, replica_id="r1", advertise_url=u1, replica_ttl=ttl,
+        batch_window=0.001, warmup=False, **kw,
+    )
+    srv1 = ServiceServer(s1, port=p1).start()
+    s2 = OptimizationService(
+        root=root, replica_id="r2", advertise_url=u2, replica_ttl=ttl,
+        batch_window=0.001, warmup=False, **kw,
+    )
+    srv2 = ServiceServer(s2, port=p2).start()
+    return (s1, srv1, u1), (s2, srv2, u2)
+
+
+def _crash(svc, srv):
+    """Kill a replica the crash way: HTTP listener gone, heartbeats
+    stopped, leases left in place to expire (nothing released)."""
+    srv.httpd.shutdown()
+    srv.httpd.server_close()
+    svc.replica_set._stop.set()
+    svc.scheduler.close(timeout=1.0)
+
+
+def _spread_names(ring, urls, per_url, prefix="fo"):
+    """Study ids whose ring primaries cover ``urls`` ``per_url`` times
+    each — the split depends on the (ephemeral) ports, so tests pick
+    names by the ring instead of assuming any fixed name spreads."""
+    want = {u: per_url for u in urls}
+    names, i = [], 0
+    while sum(want.values()):
+        sid = f"{prefix}-{i}"
+        i += 1
+        primary = ring.primary(sid)
+        if want.get(primary, 0) > 0:
+            want[primary] -= 1
+            names.append(sid)
+        assert i < 10_000, "ring never covered the requested spread"
+    return names
+
+
+class TestPerEndpointBreaker:
+    def test_one_dead_replica_does_not_blackhole_the_live_one(self):
+        """The satellite bugfix: breakers are per endpoint.  Tripping
+        the dead URL's breaker must leave the live URL's closed — and
+        calls routed there keep flowing."""
+        from hyperopt_tpu.service import ServiceClient, free_port
+
+        dead = f"http://127.0.0.1:{free_port()}"  # nothing listening
+        svc = OptimizationService(batch_window=0.001)
+        server = ServiceServer(svc).start()
+        try:
+            client = ServiceClient(
+                base_url=server.url, replicas=[dead],
+                deadline=10.0, breaker_threshold=2,
+                breaker_cooldown=30.0, failover_transport_retries=1,
+                backoff_base=0.01, backoff_max=0.05,
+            )
+            # trip the dead endpoint's breaker directly
+            for _ in range(3):
+                client.breaker_for(dead).record_failure()
+            assert client.breaker_for(dead).state == "open"
+            assert client.breaker_for(server.url).state == "closed"
+            # non-study route on the live base_url still flows
+            assert client.healthz()
+            # a study whose ring primary is the DEAD replica still gets
+            # served via failover to the live one
+            ring = client.ring
+            sid = next(
+                f"s{i}" for i in range(100)
+                if ring.primary(f"s{i}") == dead
+            )
+            client.create_study(sid, SPACE, seed=0, algo_params=RAP)
+            (t,) = client.suggest(sid)
+            client.report(sid, t["tid"], loss=1.0)
+            assert client.breaker_for(server.url).state == "closed"
+        finally:
+            server.stop()
+
+
+class TestRoutingRegressions:
+    def test_redirect_ping_pong_terminates(self):
+        """Two replicas whose stale owner hints point at EACH OTHER
+        must not hot-spin the routing loop: the per-round hop cap is
+        fixed up front (capping against the growing candidate list was
+        a tautology — every 307 grew both sides), so the round ends,
+        the outer backoff sleeps, and the deadline surfaces a transport
+        error instead of an unbounded busy-loop."""
+        import http.server
+        import socketserver
+
+        from hyperopt_tpu.service.client import ServiceTransportError
+
+        hits = []
+        servers = []
+        urls = []
+
+        def make_handler(other_index):
+            class PingPong(http.server.BaseHTTPRequestHandler):
+                def do_POST(self):
+                    hits.append(1)
+                    body = json.dumps(
+                        {"error": "NotOwner",
+                         "owner_url": urls[other_index]}
+                    ).encode()
+                    self.send_response(307)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+                def log_message(self, *a):
+                    pass
+
+            return PingPong
+
+        for other in (1, 0):
+            httpd = socketserver.TCPServer(
+                ("127.0.0.1", 0), make_handler(other)
+            )
+            servers.append(httpd)
+            urls.append(f"http://127.0.0.1:{httpd.server_address[1]}")
+            threading.Thread(
+                target=httpd.serve_forever, daemon=True
+            ).start()
+        try:
+            client = ServiceClient(
+                replicas=urls, deadline=1.0, backoff_base=0.05,
+                backoff_max=0.5,
+            )
+            t0 = time.monotonic()
+            with pytest.raises(ServiceTransportError):
+                client.suggest("pingpong")
+            assert time.monotonic() - t0 < 10.0
+            # bounded per round: initial candidates + capped hint
+            # inserts, times a handful of backoff rounds — the broken
+            # loop racked up thousands of hits and never returned
+            assert len(hits) < 200
+        finally:
+            for httpd in servers:
+                httpd.shutdown()
+
+    def test_backpressure_fails_over_to_ring_successor(self):
+        """A saturated/draining replica (503 past the backpressure
+        budget) costs the logical call one hop: the router moves on to
+        the ring successor instead of surfacing BackpressureError."""
+        import http.server
+        import socketserver
+
+        from hyperopt_tpu.service import free_port
+
+        stub_hits = []
+
+        class Draining(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                stub_hits.append(1)
+                body = json.dumps(
+                    {"error": "Backpressure", "detail": "draining"}
+                ).encode()
+                self.send_response(503)
+                self.send_header("Retry-After", "0.05")
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        stub = socketserver.TCPServer(("127.0.0.1", 0), Draining)
+        stub_url = f"http://127.0.0.1:{stub.server_address[1]}"
+        threading.Thread(target=stub.serve_forever, daemon=True).start()
+        svc = OptimizationService(batch_window=0.001)
+        server = ServiceServer(svc).start()
+        try:
+            client = ServiceClient(
+                replicas=[server.url, stub_url], deadline=20.0,
+                retry_timeout=0.2, backoff_base=0.01, backoff_max=0.05,
+            )
+            # a study whose ring PRIMARY is the draining stub, so the
+            # router must give up on it and fail over to the live one
+            sid = next(
+                f"bp{i}" for i in range(100)
+                if client.ring.primary(f"bp{i}") == stub_url
+            )
+            client.create_study(sid, SPACE, seed=0, algo_params=RAP)
+            (t,) = client.suggest(sid)
+            client.report(sid, t["tid"], loss=1.0)
+            assert len(stub_hits) >= 1  # the stub WAS tried first
+        finally:
+            server.stop()
+            stub.shutdown()
+
+    def test_unkeyed_mutation_is_not_resent_across_replicas(self):
+        """With idempotency keys disabled, a transport error on a
+        mutation must surface (single-endpoint semantics) instead of
+        re-sending the POST to the ring successor — the first send may
+        have committed, and a resend would draw a second trial."""
+        from hyperopt_tpu.service import ServiceClientError, free_port
+        from hyperopt_tpu.service.client import ServiceTransportError
+
+        dead = f"http://127.0.0.1:{free_port()}"  # nothing listening
+        svc = OptimizationService(batch_window=0.001)
+        server = ServiceServer(svc).start()
+        try:
+            client = ServiceClient(
+                replicas=[server.url, dead], deadline=10.0,
+                use_idempotency_keys=False, backoff_base=0.01,
+                backoff_max=0.05,
+            )
+            sid = next(
+                f"uk{i}" for i in range(100)
+                if client.ring.primary(f"uk{i}") == dead
+            )
+            with pytest.raises(ServiceTransportError):
+                client.create_study(sid, SPACE, seed=0, algo_params=RAP)
+            # GETs (safe to resend) still fail over to the live
+            # replica — which answers 404, proving the call ARRIVED
+            with pytest.raises(ServiceClientError) as e:
+                client.study_status(sid)
+            assert e.value.status == 404
+        finally:
+            server.stop()
+
+
+class TestReplicaServing:
+    def test_consistent_hash_spread_and_redirects(self, tmp_path):
+        (s1, srv1, u1), (s2, srv2, u2) = _replica_pair(str(tmp_path))
+        try:
+            client = ServiceClient(replicas=[u1, u2], deadline=30.0)
+            names = _spread_names(
+                client.ring, [u1, u2], 3, prefix="rs"
+            )
+            for i, sid in enumerate(names):
+                client.create_study(sid, SPACE, seed=i, algo_params=RAP)
+            owned1 = s1.replica_set.owned_studies()
+            owned2 = s2.replica_set.owned_studies()
+            assert sorted(owned1 + owned2) == sorted(names)
+            assert len(owned1) == len(owned2) == 3
+            # a SINGLE-endpoint client pointed at the WRONG replica is
+            # redirected (307 + owner hint) and lands the call
+            wrong = u1 if s2.replica_set.owned_studies() else u2
+            sid = (owned2 if wrong == u1 else owned1)[0]
+            lone = ServiceClient(wrong, deadline=30.0)
+            st = lone.study_status(sid)
+            assert st["study_id"] == sid
+            # direct raw request: the 307 carries the owner hint (a
+            # no-redirect opener — plain urllib auto-follows GET 307s,
+            # which is itself part of the contract)
+            import urllib.error
+            import urllib.request
+
+            class _NoRedirect(urllib.request.HTTPRedirectHandler):
+                def redirect_request(self, *a, **k):
+                    return None
+
+            req = urllib.request.Request(
+                wrong + f"/v1/studies/{sid}", method="GET"
+            )
+            try:
+                urllib.request.build_opener(_NoRedirect).open(
+                    req, timeout=10
+                )
+                redirected = False
+            except urllib.error.HTTPError as e:
+                redirected = e.code == 307
+                body = json.loads(e.read().decode())
+                assert body["error"] == "NotOwner"
+                assert body["owner_url"] in (u1, u2)
+                assert e.headers["Location"].startswith(
+                    body["owner_url"]
+                )
+            assert redirected
+        finally:
+            srv1.stop()
+            srv2.stop()
+
+    def test_failover_migrates_studies_and_preserves_trajectory(
+        self, tmp_path
+    ):
+        """Kill -9 semantics on one replica: every study it owned
+        migrates to the survivor after lease expiry and the trajectory
+        continues exactly where it left off — the client rides through
+        on ring failover + idempotent retries."""
+        (s1, srv1, u1), (s2, srv2, u2) = _replica_pair(
+            str(tmp_path), ttl=0.4
+        )
+        try:
+            client = ServiceClient(
+                replicas=[u1, u2], deadline=60.0, retry_timeout=60.0,
+                backoff_base=0.02, backoff_max=0.2, retry_seed=7,
+            )
+            n_pre, n_post = 3, 3
+            names = _spread_names(client.ring, [u1, u2], 2)
+            seeds = {sid: 10 + i for i, sid in enumerate(names)}
+            for sid in names:
+                client.create_study(
+                    sid, SPACE, seed=seeds[sid], algo_params=RAP
+                )
+            for sid in names:
+                for _ in range(n_pre):
+                    (t,) = client.suggest(sid)
+                    point = space_eval(SPACE, t["vals"])
+                    client.report(
+                        sid, t["tid"], loss=_objective(point)
+                    )
+            victims = s1.replica_set.owned_studies()
+            assert len(victims) == 2  # the spread put 2 on each
+            _crash(s1, srv1)
+            for sid in names:
+                for _ in range(n_post):
+                    (t,) = client.suggest(sid)
+                    point = space_eval(SPACE, t["vals"])
+                    client.report(
+                        sid, t["tid"], loss=_objective(point)
+                    )
+            # every victim migrated and the survivor owns everything
+            assert set(victims) <= set(s2.replica_set.owned_studies())
+            assert s2.replica_set.stats.get("takeover") >= len(victims)
+            # zero lost/duplicated trials, and the FULL trajectory is
+            # identical to an uninterrupted single-process run at the
+            # same seeds (exactly-once across the migration)
+            for sid in names:
+                st = client.study_status(sid)
+                assert st["n_trials"] == n_pre + n_post
+                assert st["n_completed"] == n_pre + n_post
+                twin_vals = _serial_fmin_vals(
+                    seeds[sid], n_pre + n_post, ap=RAP
+                )
+                got = _study_vals_on_disk(str(tmp_path), sid)
+                assert len(got) == len(twin_vals)
+                for g, w in zip(got, twin_vals):
+                    assert g.keys() == w.keys()
+                    for k in g:
+                        assert np.isclose(g[k], w[k]), (sid, k, g, w)
+            # the takeover record says the fsck-clean gate held
+            for rec in s2.replica_set.stats.takeovers():
+                assert rec["ok"] is True
+                assert rec["fsck_clean"] is True
+        finally:
+            srv2.stop()
+
+    def test_lease_stall_chaos_site_reclaims_and_drops(self, tmp_path):
+        """The chaos lease-renewal stall: a frozen holder past the TTL
+        loses its studies; the resumed heartbeat discovers the bumped
+        fence and relinquishes (seeded-deterministic injection)."""
+        from hyperopt_tpu.resilience.chaos import (
+            ChaosConfig,
+            ChaosMonkey,
+            active,
+        )
+
+        cfg = ChaosConfig(
+            seed=5, p_lease_stall=1.0, lease_stall_seconds=1.2
+        )
+        monkey = ChaosMonkey(cfg)
+        (s1, srv1, u1), (s2, srv2, u2) = _replica_pair(
+            str(tmp_path), ttl=0.4
+        )
+        try:
+            # stall only r1's heartbeat: r2's monkey rolls are the same
+            # site but a different key (its replica id) — force r2's
+            # rolls cold by probability bisection: simplest is to
+            # activate the monkey only around r1's heartbeat thread,
+            # which the process-wide hook cannot scope... so instead
+            # drive both under chaos and assert SOME reclaim happened
+            # deterministically for the stalled holder.
+            client = ServiceClient(replicas=[u1, u2], deadline=30.0)
+            client.create_study("stall", SPACE, seed=3, algo_params=RAP)
+            owner = (
+                s1 if s1.replica_set.owns("stall") else s2
+            )
+            other = s2 if owner is s1 else s1
+            with active(monkey):
+                deadline = time.monotonic() + 15.0
+                while (
+                    not other.replica_set.owns("stall")
+                    and time.monotonic() < deadline
+                ):
+                    time.sleep(0.05)
+            assert other.replica_set.owns("stall"), (
+                "stalled holder was never reclaimed"
+            )
+            assert monkey.stats.get("chaos_lease_stall") >= 1
+            # the stalled owner relinquishes on resume (or at latest on
+            # its next serve attempt); its credential is dead either way
+            handle = owner.replica_set.handle_of("stall")
+            assert handle is None or not owner.replica_set.leases.verify(
+                "stall", owner.replica_set.replica_id, handle.fence
+            )
+        finally:
+            srv1.stop()
+            srv2.stop()
+
+    def test_client_partition_chaos_site_rides_on_failover(
+        self, tmp_path
+    ):
+        """Asymmetric partition: client↔replica dead while
+        replica↔store stays alive.  The lease never expires (no
+        failover), so redirects + ring retry alone must carry the
+        call once the window closes."""
+        from hyperopt_tpu.resilience.chaos import (
+            ChaosConfig,
+            ChaosMonkey,
+            active,
+        )
+
+        (s1, srv1, u1), (s2, srv2, u2) = _replica_pair(
+            str(tmp_path), ttl=5.0
+        )
+        try:
+            client = ServiceClient(
+                replicas=[u1, u2], deadline=40.0, retry_timeout=40.0,
+                backoff_base=0.02, backoff_max=0.2,
+            )
+            client.create_study("pt", SPACE, seed=1, algo_params=RAP)
+            owner = s1 if s1.replica_set.owns("pt") else s2
+            cfg = ChaosConfig(
+                seed=11, p_client_partition=1.0, partition_seconds=1.0
+            )
+            monkey = ChaosMonkey(cfg)
+            with active(monkey):
+                (t,) = client.suggest("pt")  # rides out the window
+                client.report("pt", t["tid"], loss=0.5)
+            assert monkey.stats.get("chaos_client_partition") >= 1
+            # no failover fired: the owner kept its lease throughout
+            assert owner.replica_set.owns("pt")
+            assert client.study_status("pt")["n_completed"] == 1
+        finally:
+            srv1.stop()
+            srv2.stop()
+
+
+def _study_vals_on_disk(root, study_id):
+    """Per-trial vals trajectory read straight off the shared store."""
+    from hyperopt_tpu.parallel.file_trials import FileTrials
+
+    qdir = os.path.join(root, "studies", study_id)
+    docs = sorted(
+        FileTrials(qdir)._dynamic_trials, key=lambda d: int(d["tid"])
+    )
+    return [
+        {k: v[0] for k, v in d["misc"]["vals"].items() if len(v)}
+        for d in docs
+    ]

@@ -294,7 +294,7 @@ class TestMultiWindow:
         rows = env["engine"].evaluate(force=True)
         assert {r["rule"] for r in rows} == {
             "SL601", "SL602", "SL603", "SL604", "SL605", "SL606",
-            "SL607",
+            "SL607", "SL608",
         }
         for r in rows:
             assert r["status"] in ("ok", "breach", "no_data")
@@ -511,7 +511,7 @@ class TestServiceIntegration:
             al = svc.alerts()
             assert {r["rule"] for r in al["rules"]} == {
                 "SL601", "SL602", "SL603", "SL604", "SL605", "SL606",
-                "SL607",
+                "SL607", "SL608",
             }
             assert al["breaching"] == [
                 r["rule"] for r in al["rules"] if not r["ok"]
@@ -539,7 +539,7 @@ class TestServiceIntegration:
         try:
             client = ServiceClient(server.url)
             al = client.alerts()
-            assert len(al["rules"]) == 7
+            assert len(al["rules"]) == 8
             st = client.service_status()
             assert "version" in st and "started_at" in st
             assert st["version"]["version"]
