@@ -441,10 +441,17 @@ def _tpu_smoke():
     # reach the artifact, and the two paths can no longer diverge
     # silently (the measured values still differ only when both were
     # probed and disagreed; resolve_fma applies the single-probe
-    # fallback either way)
+    # fallback either way).  Each default is stamped WITH its measured
+    # basis (env pin / own probe / sibling-probe fallback / unprobed)
+    # so two artifacts showing different defaults are explainable
+    # rather than contradictory.
     return scorer, err, (
         pallas_gmm.resolve_fma("batched"),
         pallas_gmm.resolve_fma("unbatched"),
+        {
+            "batched": pallas_gmm.resolve_fma_basis("batched"),
+            "unbatched": pallas_gmm.resolve_fma_basis("unbatched"),
+        },
     )
 
 
@@ -966,6 +973,57 @@ def device_profile_section(argv):
     return 0 if report["ok"] else 1
 
 
+def fused_section(argv):
+    """``python bench.py --fused [--quick]``: fused-mega-kernel smoke —
+    runs scripts/fused_report.py.  ``--quick`` (the CI default) forces
+    interpret mode on CPU and asserts the STRUCTURAL contract: bitwise
+    fused==reference winners across the shape grid (incl. the
+    100k-tiled case), trial-for-trial trajectory identity against the
+    unfused path, and one-trace-per-bucket under the
+    RecompilationAuditor; a full run on the TPU host additionally
+    measures the fused-vs-unfused EI-evals/s headline.  Writes
+    ``BENCH_TPU_fused[.quick].json`` (a quick run never clobbers the
+    committed full artifact — the PR 7 convention).  Prints ONE JSON
+    line like the other bench sections."""
+    if "--quick" in argv:
+        # the quick smoke's contract is the CPU-checkable parity tier:
+        # pin the CPU backend and force the Pallas interpreter even if
+        # a TPU is visible.  A FULL run must keep the live backend —
+        # it exists to measure the fused-vs-unfused headline on TPU
+        # (the sharded_section convention).
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        os.environ["HYPEROPT_TPU_FUSED_INTERPRET"] = "1"
+    fused_report = _import_script("fused_report")
+    quick = "--quick" in argv
+    out_path = (
+        "BENCH_TPU_fused.quick.json" if quick else "BENCH_TPU_fused.json"
+    )
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    t0 = time.time()
+    report = fused_report.run_fused(quick=quick)
+    fused_report.write_report(report, out_path)
+    exact = [p for p in report["parity"] if not p["draw_in_kernel"]]
+    out = {
+        "metric": "fused_kernel_smoke",
+        "value": sum(1 for p in exact if p["winner_bitwise_match"]),
+        "unit": "bitwise_parity_cases",
+        "ok": report["ok"],
+        "platform": report["platform"],
+        "n_parity_cases": report["n_parity_cases"],
+        "trajectory_identical": report["trajectory"]["identical"],
+        "one_trace_per_bucket": report["recompilation"][
+            "one_trace_per_bucket"
+        ],
+        "tiling_covered": report["tiling_100k"]["covered"],
+        "errors": report["errors"],
+        "artifact": out_path,
+        "elapsed_s": round(time.time() - t0, 2),
+    }
+    print(json.dumps(out))
+    return 0 if report["ok"] else 1
+
+
 def failover_section(argv):
     """``python bench.py --failover [--quick]``: replica-plane warm
     failover smoke — the seeded failover campaign
@@ -1054,6 +1112,9 @@ def main():
     if "--failover" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--failover"]
         return failover_section(argv)
+    if "--fused" in sys.argv[1:]:
+        argv = [a for a in sys.argv[1:] if a != "--fused"]
+        return fused_section(argv)
     if "--chaos" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--chaos"]
         return chaos_section(argv)
@@ -1193,6 +1254,10 @@ def main():
             "precision_max_err": round(smoke_err, 6),
             "pallas_fma_default": smoke_fma[0],
             "pallas_fma_default_unbatched": smoke_fma[1],
+            # the probe's measured basis per entry point — both values
+            # route through the ONE resolve_fma resolver, and the basis
+            # explains any per-kernel disagreement (ISSUE-14 satellite)
+            "pallas_fma_basis": smoke_fma[2],
         },
         "scorer_ab": ab,
         "compile_warmup_s": round(warmup_s, 2),

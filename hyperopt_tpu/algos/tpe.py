@@ -208,6 +208,104 @@ def _host_label_keys(seed: int, n: int):
 
 _probed_scorer = None
 _fma_probe_attempted = False
+_fused_probe_attempted = False
+
+
+def _fused_probe() -> bool:
+    """Lower + run a tiny fused mega-kernel once; False if Mosaic
+    rejects.  Same contract as :func:`_pallas_probe`: a lowering
+    failure on real hardware must demote to the plain Pallas scorer,
+    never take down the suggest path (``interpret=True`` tests cannot
+    catch a Mosaic rejection)."""
+    import jax
+
+    try:
+        import jax.numpy as jnp
+
+        from ..ops.pallas_fused import fused_suggest_pallas
+
+        L, kb, C = 2, 4, 16
+        cand = jnp.tile(jnp.linspace(-1.0, 1.0, C), (L, 1))
+        rows = jnp.zeros((L, 7, kb), jnp.float32)
+        p = jnp.zeros((L, 3, kb + 8), jnp.float32).at[:, 2].set(-1.0)
+        out = fused_suggest_pallas(
+            cand, jnp.zeros_like(cand), rows, p, k_below=kb, k=1,
+            interpret=False,
+        )
+        jax.block_until_ready(out[0])
+        return True
+    except Exception as exc:  # pragma: no cover - exercised on TPU only
+        logger.warning(
+            "fused mega-kernel failed to lower/run on backend %r (%s); "
+            "staying on the plain Pallas scorer",
+            jax.default_backend(),
+            exc,
+        )
+        return False
+
+
+def _fused_timing_probe(k_total=8192 + 32, n_cand=2048, n_labels=4, iters=8):
+    """Time the fused mega-kernel against the unfused draw + Pallas
+    scorer + argmax chain once per process (real TPUs only) and record
+    the verdict via ``pallas_fused.set_default_fused`` — the
+    ``resolve_fma`` pattern one tier up.  The env pin
+    (``HYPEROPT_TPU_FUSED``) wins outright and skips the probe."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import pallas_fused
+    from ..ops.pallas_gmm import pair_score_pallas_batched
+    from ..ops.score import pair_params
+
+    kb = 32
+    rngp = np.random.default_rng(0)
+    w = jnp.asarray(np.abs(rngp.normal(size=k_total)) + 0.1, jnp.float32)
+    params = pair_params(
+        w[:kb] / jnp.sum(w[:kb]),
+        jnp.asarray(rngp.normal(size=kb), jnp.float32),
+        w[:kb] * 0 + 1.0,
+        w[kb:] / jnp.sum(w[kb:]),
+        jnp.asarray(rngp.normal(size=k_total - kb), jnp.float32),
+        w[kb:] * 0 + 1.0,
+    )
+    params = jnp.tile(params[None], (n_labels, 1, 1))
+    z = jnp.tile(jnp.linspace(-2.0, 2.0, n_cand), (n_labels, 1))
+    rows = jnp.zeros((n_labels, 7, kb), jnp.float32)
+
+    def timed(fused: bool) -> float:
+        @jax.jit
+        def chain(z0):
+            def body(_, c):
+                zc = z0 + c * jnp.float32(1e-7)
+                if fused:
+                    win, _i, _m, _s, _t = pallas_fused._fused_suggest_pallas(
+                        zc, jnp.zeros_like(zc), rows, params, kb, 1,
+                        16, 512, 512, False, False, False,
+                        pallas_fused.resolve_fma("batched"),
+                    )
+                    return win[0, 0] * jnp.float32(1e-7)
+                s = pair_score_pallas_batched(zc, params, kb)
+                idx = jnp.argmax(s, axis=1)
+                win = jnp.take_along_axis(zc, idx[:, None], axis=1)
+                return win[0, 0] * jnp.float32(1e-7)
+
+            return jax.lax.fori_loop(0, iters, body, jnp.float32(0.0))
+
+        jax.block_until_ready(chain(z))  # compile + warm
+        t0 = time.perf_counter()
+        jax.block_until_ready(chain(z))
+        return (time.perf_counter() - t0) / iters
+
+    t_unfused = timed(False)
+    t_fused = timed(True)
+    winner = t_fused < t_unfused
+    pallas_fused.set_default_fused(winner)
+    logger.info(
+        "fused mega-kernel probe: unfused %.3f ms, fused %.3f ms -> %s",
+        t_unfused * 1e3, t_fused * 1e3, "fused" if winner else "pallas",
+    )
 
 
 def _pallas_probe() -> bool:
@@ -324,8 +422,15 @@ def _use_pallas():
     Probes the Pallas path once per process and demotes to "xla" if it
     cannot lower; a second probe times the kernel's MXU-dot vs VPU-FMA
     modes and keeps the faster (skip with HYPEROPT_TPU_FMA_PROBE=0, or
-    pin the mode with HYPEROPT_TPU_PALLAS_FMA).  Override the scorer
-    choice itself with HYPEROPT_TPU_SCORER=pallas|xla|exact.
+    pin the mode with HYPEROPT_TPU_PALLAS_FMA); a third probes the
+    fused mega-kernel (lowering + A/B timing vs the unfused chain,
+    recorded via ``pallas_fused.set_default_fused``) and promotes to
+    the "fused" tier when ``pallas_fused.resolve_fused`` says so —
+    i.e. when ``HYPEROPT_TPU_FUSED=1`` or the probe measured a win
+    (skip with HYPEROPT_TPU_FUSED_PROBE=0).  On TPU the promotion is
+    trajectory-safe: the fused kernel's scores are bit-identical to
+    the batched Pallas scorer it replaces.  Override the scorer choice
+    itself with HYPEROPT_TPU_SCORER=pallas|xla|exact|fused.
     """
     import os
 
@@ -350,9 +455,31 @@ def _use_pallas():
             except Exception as exc:  # pragma: no cover - TPU only
                 logger.warning("pallas kernel-mode probe failed: %s", exc)
 
+    def maybe_probe_fused():
+        # once per process, TPU only, env pin wins (resolve_fused reads
+        # HYPEROPT_TPU_FUSED first so a failed/skipped probe leaves the
+        # opt-in default: off)
+        global _fused_probe_attempted
+        if (
+            not _fused_probe_attempted
+            and jax.default_backend() == "tpu"
+            and os.environ.get("HYPEROPT_TPU_FUSED_PROBE") != "0"
+            and os.environ.get("HYPEROPT_TPU_FUSED") is None
+        ):
+            _fused_probe_attempted = True
+            try:  # pragma: no cover - exercised on TPU only
+                from ..ops import pallas_fused
+
+                if _fused_probe():
+                    _fused_timing_probe()
+                else:
+                    pallas_fused.set_default_fused(False)
+            except Exception as exc:  # pragma: no cover - TPU only
+                logger.warning("fused mega-kernel probe failed: %s", exc)
+
     forced = os.environ.get("HYPEROPT_TPU_SCORER")
     if forced:
-        if forced == "pallas":
+        if forced in ("pallas", "fused"):
             maybe_probe_kernel_mode()
         return forced
 
@@ -363,6 +490,12 @@ def _use_pallas():
         _probed_scorer = "pallas" if _pallas_probe() else "xla"
         if _probed_scorer == "pallas":
             maybe_probe_kernel_mode()
+    if _probed_scorer == "pallas":
+        from ..ops import pallas_fused
+
+        maybe_probe_fused()
+        if pallas_fused.resolve_fused():
+            return "fused"
     return _probed_scorer
 
 
@@ -614,6 +747,25 @@ def _suggest_device(
                         priors[i, 1] = min(float(priors[i, 1]), 2.0 * radius)
                         priors[i, 2], priors[i, 3] = lo, hi
                         lock_c[i], lock_r[i] = c_fit, radius
+            st = dict(
+                cap_b=cap_b, k=k, n_cand=int(n_EI_candidates), lf=lf,
+                log_scale=fam.log_scale, quantized=fam.quantized,
+                scorer=scorer, mesh=mesh,
+                n_buckets=_family_bucket_count(
+                    fam, k * int(n_EI_candidates)
+                )
+                if fam.quantized
+                else 0,
+            )
+            if scorer == "fused":
+                # in-kernel-draw opt-in, resolved OUTSIDE jit (env read
+                # here, not at trace time) and made a static so the two
+                # draw modes never share a jit cache entry.  Only fused
+                # programs carry the key — every other tier's signature
+                # (and the compile ledger's recorded grid) is unchanged.
+                from ..ops.pallas_fused import resolve_fused_draw
+
+                st["fused_draw"] = resolve_fused_draw()
             requests.append((
                 "cont",
                 (
@@ -621,16 +773,7 @@ def _suggest_device(
                     keep_mask, np.int32(n_below), np.float32(prior_weight),
                     priors, lock_c, lock_r,
                 ),
-                dict(
-                    cap_b=cap_b, k=k, n_cand=int(n_EI_candidates), lf=lf,
-                    log_scale=fam.log_scale, quantized=fam.quantized,
-                    scorer=scorer, mesh=mesh,
-                    n_buckets=_family_bucket_count(
-                        fam, k * int(n_EI_candidates)
-                    )
-                    if fam.quantized
-                    else 0,
-                ),
+                st,
             ))
         else:
             if param_locks:

@@ -693,10 +693,21 @@ def _family_suggest_core(
     scorer: str,
     n_buckets: int = 0,
     mesh=None,
+    fused_draw: bool = False,
 ):
     """ONE device program: γ-split → pack → Parzen fits → truncated-GMM
     draw → log l − log g → per-id argmax, stacked over the family's L
     labels.  Output: winning values [L, k] (fit space).
+
+    ``scorer="fused"`` (static; see ``ops.score.effective_scorer``)
+    routes the draw → score → top-k stages through the Pallas
+    mega-kernel (:mod:`hyperopt_tpu.ops.pallas_fused`): the candidate
+    and score vectors live in VMEM between stages and only the [L, k]
+    winners plus the [L, DIAG_COLS] telemetry partials come back.
+    ``fused_draw`` (static, only present on fused programs) moves the
+    candidate draw itself in-kernel — the documented-tolerance opt-in;
+    the default streams ``gmm_sample``'s own candidates through the
+    kernel so the fused path stays bit-exact against the unfused draw.
 
     ``mesh`` (static): shard the scoring across it — pair scoring via
     :func:`parallel.sharding.make_sharded_pair_score_batched` (candidates
@@ -722,6 +733,18 @@ def _family_suggest_core(
     L = obs.shape[0]
     ranks = _loss_ranks(losses, keep_mask)
 
+    # the fused mega-kernel replaces the pair-scorer stage only — the
+    # quantized/exact lpdf branches keep their paths; the K-crossover
+    # demotion mirrors the pallas tier (ops.score.effective_scorer)
+    from ..ops.score import effective_scorer
+
+    use_fused = (
+        not quantized
+        and scorer != "exact"
+        and effective_scorer(scorer, (cap_b + 1) + (obs.shape[1] + 1))
+        == "fused"
+    )
+
     def fit_sample(key, obs_l, pos_l, count_l, pri, c, r):
         pm, ps, lo, hi, qq = pri[0], pri[1], pri[2], pri[3], pri[4]
         below, nb, above, na = _split_pack(
@@ -734,6 +757,20 @@ def _family_suggest_core(
         wa, ma, sa = parzen_ops.adaptive_parzen_normal_padded(
             above, na, prior_weight, pm, ps, lf
         )
+        if use_fused and fused_draw:
+            # in-kernel draw (the documented-tolerance opt-in): hand the
+            # kernel the raw uniform streams under gmm_sample's exact
+            # key discipline (split → uniform, f32) plus the
+            # per-component draw table; no candidates materialize here
+            import jax.numpy as jnp
+
+            from ..ops import pallas_fused
+
+            k_comp, k_val = jax.random.split(key)
+            u1 = jax.random.uniform(k_comp, (k * n_cand,), jnp.float32)
+            u2 = jax.random.uniform(k_val, (k * n_cand,), jnp.float32)
+            rows = pallas_fused.draw_param_rows(wb, mb, sb, lo, hi)
+            return (u1, u2, rows), (wb, mb, sb), (wa, ma, sa), nb, na
         cand = gmm_ops.gmm_sample(key, wb, mb, sb, lo, hi, qq, k * n_cand, log_scale)
         return cand, (wb, mb, sb), (wa, ma, sa), nb, na
 
@@ -790,6 +827,13 @@ def _family_suggest_core(
             score = jax.lax.with_sharding_constraint(
                 score, NamedSharding(mesh, PartitionSpec())
             )
+    elif use_fused:
+        params = jax.vmap(pair_params)(*B, *A)  # [L, 3, Kb+Ka]
+        win, (ei_max, ei_lme, ei_mass) = _fused_winners(
+            mesh, cands, params, B[0].shape[1], k=k, n_cand=n_cand,
+            log_scale=log_scale, fused_draw=fused_draw,
+        )
+        score = None
     else:
         z = jnp.log(jnp.maximum(cands, EPS)) if log_scale else cands
         params = jax.vmap(pair_params)(*B, *A)  # [L, 3, Kb+Ka]
@@ -797,15 +841,16 @@ def _family_suggest_core(
         if mesh is not None:
             score = _sharded_pair_apply(mesh, z, params, k_below)
         else:
-            from ..ops.score import effective_scorer
-
             if effective_scorer(scorer, params.shape[-1]) == "pallas":
                 score = pair_score_pallas_batched(z, params, k_below)
             else:
                 score = jax.vmap(partial(pair_score, k_below=k_below))(z, params)
     # search-health reductions on the scores/fits already in hand (a few
-    # scalars appended to the flat output; never touches the winner math)
-    ei_max, ei_lme, ei_mass = _ei_diag(score.reshape(L, k * n_cand))
+    # scalars appended to the flat output; never touches the winner math).
+    # On the fused path the EI reductions were accumulated in-kernel —
+    # the scores never materialized to reduce over.
+    if score is not None:
+        ei_max, ei_lme, ei_mass = _ei_diag(score.reshape(L, k * n_cand))
     sig_min, sig_mean, sig_floor = _sigma_diag(B[0], B[2], nbs, priors[:, 1])
     diag = jnp.stack(
         [
@@ -814,10 +859,11 @@ def _family_suggest_core(
         ],
         axis=1,
     )  # [L, DIAG_COLS]
-    score = score.reshape(L, k, n_cand)
-    cands = cands.reshape(L, k, n_cand)
-    idx = jnp.argmax(score, axis=2)  # [L, k]
-    win = jnp.take_along_axis(cands, idx[:, :, None], axis=2)[:, :, 0]
+    if score is not None:
+        score = score.reshape(L, k, n_cand)
+        cands = cands.reshape(L, k, n_cand)
+        idx = jnp.argmax(score, axis=2)  # [L, k]
+        win = jnp.take_along_axis(cands, idx[:, :, None], axis=2)[:, :, 0]
     return win, diag
 
 
@@ -862,6 +908,51 @@ def _sharded_pair_apply(mesh, z, params, k_below):
     # region ends HERE, downstream must compile as the single-chip
     # program (same partitioner-bug containment as the input pins)
     return jax.lax.with_sharding_constraint(s[:, :C], rep)
+
+
+def _fused_winners(mesh, cands, params, k_below, *, k, n_cand, log_scale,
+                   fused_draw):
+    """Run the fused Pallas mega-kernel (draw → score → top-k in one
+    launch, :mod:`hyperopt_tpu.ops.pallas_fused`) and combine its EI
+    partials into the ``_ei_diag``-shape reductions.
+
+    Under a ``DeviceMesh`` every ``pallas_call`` operand is pinned
+    REPLICATED first — the PL206 contract extended to the new kernel
+    (PL209): without the pins, the SPMD partitioner could propagate a
+    sharding into the kernel's operands exactly the way it miscompiled
+    ``pair_params``' unequal-size concat in the PR 11 class.  Pinned,
+    the mega-kernel compiles as the single-chip program on every
+    device, and determinism (sharded ≡ unsharded, trial-for-trial) is
+    preserved by construction.
+    """
+    import jax.numpy as jnp
+
+    from ..ops import pallas_fused
+
+    if fused_draw:
+        u1, u2, rows = cands
+    else:
+        # exact-draw default: lane 0 streams gmm_sample's candidates,
+        # the draw-table operands are inert zeros
+        u1 = cands
+        u2 = jnp.zeros_like(u1)
+        rows = jnp.zeros((u1.shape[0], 7, k_below), jnp.float32)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = NamedSharding(mesh, PartitionSpec())
+        u1, u2, rows, params = tuple(
+            jax.lax.with_sharding_constraint(a, rep)
+            for a in (u1, u2, rows, params)
+        )
+    n_top = min(D_EI_TOP_K, k * n_cand)
+    win, _idx, seg_m, seg_s, seg_top = pallas_fused.fused_suggest_pallas(
+        u1, u2, rows, params, k_below=k_below, k=k, n_top=n_top,
+        log_scale=log_scale, draw_in_kernel=fused_draw,
+    )
+    ei = pallas_fused.ei_from_partials(seg_m, seg_s, seg_top, k * n_cand,
+                                       n_top)
+    return win, ei
 
 
 def _index_family_suggest_core(

@@ -460,6 +460,9 @@ _PIN_EXPECTATIONS = {
     "_build_multi_run": 1,
     "_family_suggest_core": 3,
     "_sharded_pair_apply": 3,
+    # the fused mega-kernel's dispatch helper: every pallas_call
+    # operand pinned replicated under a mesh (the PL209 contract)
+    "_fused_winners": 1,
 }
 
 _DISPATCH_FNS = (
@@ -801,6 +804,20 @@ def scan_partition_jaxpr(closed_jaxpr, location: str) -> List[Diagnostic]:
                 for ov in eqn.outvars:
                     taint[id(ov)] = t
                 continue
+            if name == "pallas_call" and in_taint:
+                out.append(make(
+                    "PL209", location,
+                    "a sharded (non-replicated, not re-pinned) value "
+                    "reaches a pallas_call operand: the SPMD "
+                    "partitioner may split the kernel's inputs the way "
+                    "it miscompiled pair_params' unequal concat (the "
+                    "PR 11 class) — the fused mega-kernel must only "
+                    "ever see replicated operands",
+                    hint="pin every kernel operand replicated first "
+                         "(with_sharding_constraint(x, NamedSharding("
+                         "mesh, PartitionSpec())) — see "
+                         "tpe_device._fused_winners)",
+                ))
             if name == "concatenate" and in_taint:
                 dim = eqn.params.get("dimension", 0)
                 sizes = {
@@ -882,7 +899,65 @@ def lint_partition_program(requests=None, mesh=None,
     names = [n for n in getattr(mesh, "axis_names", ())]
     shape = "x".join(str(int(mesh.shape[n])) for n in names)
     loc = f"tpe_device.multi_family_suggest[mesh {shape}]"
-    return apply_suppressions(scan_partition_jaxpr(closed, loc), suppress)
+    out = scan_partition_jaxpr(closed, loc)
+    # fused arm (PL209): the same program with the cont families routed
+    # through the fused mega-kernel — traced with interpret forced OFF
+    # so the pallas_call primitive (and any sharding reaching its
+    # operands) is visible in the jaxpr
+    fused = [
+        (
+            kind,
+            args,
+            dict(st, mesh=mesh, scorer="fused",
+                 **({} if st.get("quantized") else {"fused_draw": False}))
+            if kind == "cont" else st,
+        )
+        for kind, args, st in requests
+    ]
+    # HYPEROPT_TPU_SCORER must be FORCED for the trace: without it,
+    # effective_scorer demotes the probe's small-history fused request
+    # to "xla" (k_total < PALLAS_MIN_K) and the arm would audit the
+    # ordinary unfused program — a vacuous guard.  Forced scorers are
+    # honored verbatim, so the mega-kernel really traces here.
+    saved = {
+        k: os.environ.get(k)
+        for k in ("HYPEROPT_TPU_FUSED_INTERPRET", "HYPEROPT_TPU_SCORER")
+    }
+    os.environ["HYPEROPT_TPU_FUSED_INTERPRET"] = "0"
+    os.environ["HYPEROPT_TPU_SCORER"] = "fused"
+    try:
+        closed_fused = tpe_device.multi_family_jaxpr(fused)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    loc_fused = f"tpe_device.multi_family_suggest[mesh {shape}, fused]"
+    fused_diags = scan_partition_jaxpr(closed_fused, loc_fused)
+    # the arm must not be vacuous: the mega-kernel's pallas_call has to
+    # be IN the traced program for the PL209 taint check to mean
+    # anything (a silent demotion here would green-light pin removals)
+    if not _contains_pallas_call(closed_fused.jaxpr):
+        fused_diags.append(make(
+            "PL209", loc_fused,
+            "the fused audit arm traced a program with no pallas_call: "
+            "the mega-kernel was demoted or bypassed, so the "
+            "operand-pin audit is vacuous",
+            severity="warning",
+            hint="check effective_scorer's fused routing and the "
+                 "HYPEROPT_TPU_SCORER force in lint_partition_program",
+        ))
+    out.extend(fused_diags)
+    return apply_suppressions(out, suppress)
+
+
+def _contains_pallas_call(jaxpr) -> bool:
+    for jx in _iter_jaxprs(jaxpr):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "pallas_call":
+                return True
+    return False
 
 
 # ---------------------------------------------------------------------

@@ -1,0 +1,465 @@
+"""Pallas TPU mega-kernel: sampling → scoring → top-k in ONE launch.
+
+The fused-suggest inner loop of Bergstra et al.'s TPE (draw candidates
+from the below mixture l(x), rank them by ``log l(x) − log g(x)``, keep
+the per-label winner) currently runs as a chain of XLA ops with the
+candidate and score vectors round-tripping through HBM between stages,
+and — on the Pallas scorer tier — a separate ``pallas_call`` for the
+scoring alone.  ``DEVICE_PROFILE.json`` shows that chain compute-bound
+at ~1.9% of its roofline: the headroom is in the kernel, not the
+memory system.  This module fuses the whole loop into one
+``pl.pallas_call`` so candidates, scores, and EI reductions live
+entirely in VMEM/registers between stages:
+
+- **draw** (per candidate tile, opt-in — see below): inverse-CDF
+  component selection against the below mixture's VMEM-resident
+  ``cdf`` (searchsorted computed as a ``count(cdf <= t)`` reduction —
+  exactly ``jnp.searchsorted(..., side="right")`` on a monotone
+  cumsum), then the truncated-normal inverse transform.  The raw
+  uniforms are drawn OUTSIDE the kernel with the same ``jax.random``
+  key discipline as :func:`hyperopt_tpu.ops.gmm.gmm_sample` (split →
+  uniform, f32), and the in-kernel transform mirrors
+  ``jax.random.truncated_normal``'s op chain term for term (erf bounds
+  precomputed per component, ``max(a, u·(b−a)+a)`` → ``√2·erf_inv`` →
+  nextafter clamp);
+- **score**: the flash-style online logsumexp of
+  :mod:`hyperopt_tpu.ops.pallas_gmm` (same ``_region_logsumexp``, same
+  region padding, same tile sizes) over the ``[3, Kb+Ka]`` parameter
+  block resident in VMEM — the ``[C, K]`` comp matrix never exists,
+  and the per-candidate scores never leave registers;
+- **select**: a running (best score, best value, best index) per
+  (label, suggestion) accumulated across candidate tiles with strict-
+  ``>`` updates (ties keep the earliest index — ``jnp.argmax``
+  semantics), plus the EI-telemetry reductions
+  (:func:`hyperopt_tpu.algos.tpe_device._ei_diag` parity): a running
+  (max, sum-exp) pair and a running top-``n_top`` score set, merged
+  tile by tile in-kernel and combined across segments by
+  :func:`ei_from_partials` outside.
+
+Tiling: the grid is ``(L, k, candidate-tiles)`` and the component axis
+is tiled INSIDE the kernel by ``pl.ds`` lane slices over the
+VMEM-resident parameter block (``tk``-sized steps, the
+``pallas_gmm`` pattern) — at a 100k-trial history the block is
+``[3, ~131k]`` ≈ 1.6 MB, comfortably VMEM-resident, and the inner loop
+walks it in 512-lane tiles.  Candidate padding (``n_cand`` rounded up
+to the tile) consumes NO extra uniforms — the u-streams are generated
+at exactly ``k·n_cand`` and padded after — so the draw stream stays
+aligned with the unfused path.
+
+Numeric contract: in the DEFAULT exact-draw mode the candidates are
+``gmm_ops.gmm_sample``'s own values (drawn inside the same fused XLA
+program and streamed through the kernel — bit-identical to the unfused
+draw by construction), and the scores are bit-identical to
+``pair_score_pallas_batched`` at the same tile sizes (same online
+accumulation): the winner matches the Pallas scorer tier bit-for-bit
+and the XLA tier up to float-associativity near-ties in the score.
+The full in-kernel draw is a further opt-in (:func:`resolve_fused_draw`
+— ``HYPEROPT_TPU_FUSED_DRAW=1``): measured on this jax build, XLA's
+FMA contraction inside ``gmm_sample``'s jit rounds ``μ + σ·u`` once
+while a separate context rounds it twice, so in-kernel-drawn candidate
+values differ from the unfused draw in the last 1-2 ulp — hence
+default off, with the tolerance documented here and in docs/API.md.
+
+CPU/testing: ``interpret=None`` resolves to the Pallas interpreter
+off-TPU, so forcing the fused tier on CPU (``HYPEROPT_TPU_SCORER=
+fused``) runs interpret-mode automatically.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from .pallas_gmm import (
+    NEG_BIG,
+    _pad_regions,
+    _region_logsumexp,
+    _region_tile,
+    env_bool,
+    resolve_fma,
+)
+
+EPS = 1e-12
+_SQRT2 = np.float32(np.sqrt(2.0))
+
+# accumulator lane layout (row 0 of the [8, 128] per-(label, suggestion)
+# block); row 1 carries the running top-k score set in lanes [0, n_top)
+_ACC_BEST, _ACC_VAL, _ACC_ARG, _ACC_M, _ACC_S = 0, 1, 2, 3, 4
+
+
+def draw_param_rows(w, mu, sigma, low, high):
+    """The below-mixture draw, precomputed to the 7-row per-component
+    block the kernel's sampling stage reads ([7, K]):
+
+    ``cdf`` (cumsum of in-bounds mass — the inverse-CDF table),
+    ``mu``, ``sigma``, ``erf(a/√2)``, ``erf(b/√2)`` (the
+    truncated-normal uniform bounds), ``nextafter(a, +inf)``,
+    ``nextafter(b, −inf)`` (its clamp bounds) — every term computed
+    with the exact op chain of ``gmm_ops.gmm_sample`` +
+    ``jax.random.truncated_normal`` so the in-kernel transform
+    reproduces the unfused draw bit-for-bit.
+    """
+    from jax.scipy.special import ndtr
+
+    a = (low - mu) / jnp.maximum(sigma, EPS)
+    b = (high - mu) / jnp.maximum(sigma, EPS)
+    a = jnp.clip(a, -30.0, 30.0)
+    b = jnp.clip(b, -30.0, 30.0)
+    Z = ndtr(b) - ndtr(a)
+    p = jnp.maximum(w * Z, 0.0)
+    cdf = jnp.cumsum(p)
+    return jnp.stack([
+        cdf,
+        mu,
+        sigma,
+        jax.lax.erf(a / _SQRT2),
+        jax.lax.erf(b / _SQRT2),
+        jnp.nextafter(a, jnp.float32(np.inf)),
+        jnp.nextafter(b, jnp.float32(-np.inf)),
+    ])
+
+
+def _fused_kernel(uv_ref, dp_ref, p_ref, acc_ref, *, KD, KB, KA, TKB, TKA,
+                  k_real, n_cand, tc, n_top, log_scale, draw_in_kernel, fma):
+    i = pl.program_id(2)
+    uv = uv_ref[0, 0]                      # [TC, 2]
+
+    if draw_in_kernel:
+        # --- draw: inverse-CDF component pick + truncated-normal ------
+        u1, u2 = uv[:, 0], uv[:, 1]
+        dp = dp_ref[0]                     # [8, KD]
+        cdf = dp[0]
+        total = cdf[KD - 1]                # KD pads cdf with its edge value
+        t = jnp.minimum(u1 * total, total * jnp.float32(1.0 - 1e-6))
+        # searchsorted(cdf, t, side="right") on a monotone cumsum is the
+        # count of entries <= t; padding entries equal total > t and are
+        # never counted (exact integer equivalence, no binary search)
+        ik = jax.lax.broadcasted_iota(jnp.int32, (tc, KD), 1)
+        comp = jnp.sum((cdf[None, :] <= t[:, None]).astype(jnp.float32),
+                       axis=1).astype(jnp.int32)
+        comp = jnp.minimum(comp, k_real - 1)
+        sel = (comp[:, None] == ik).astype(jnp.float32)  # exact one-hot
+
+        def pick(row):
+            # one-hot masked sum: exactly one term is 1·v, the rest 0·v
+            # — an exact gather however Mosaic vectorizes the reduction
+            return jnp.sum(sel * row[None, :], axis=1)
+
+        mu_s, sig_s = pick(dp[1]), pick(dp[2])
+        ae, be = pick(dp[3]), pick(dp[4])
+        lo_n, hi_n = pick(dp[5]), pick(dp[6])
+        # jax.random.truncated_normal's op chain, term for term.  NOTE
+        # (the documented tolerance of the in-kernel draw): XLA is free
+        # to contract mul+add chains into FMAs differently here than
+        # inside gmm_sample's jit, so the drawn values can differ from
+        # the unfused draw in the last ulp — which is why this mode is
+        # an explicit opt-in (resolve_fused_draw) and the default
+        # streams gmm_sample's own candidates through the kernel.
+        u = jnp.maximum(ae, u2 * (be - ae) + ae)
+        xt = _SQRT2 * jax.lax.erf_inv(u)
+        xt = jnp.clip(xt, lo_n, hi_n)
+        xf = mu_s + sig_s * xt             # fit-space candidate
+        if log_scale:
+            x = jnp.exp(xf)                # raw candidate (gmm_sample)
+        else:
+            x = xf
+    else:
+        # exact-draw mode (the default): lane 0 carries the candidates
+        # gmm_sample drew inside the same fused program — bit-identical
+        # to the unfused path by construction
+        x = uv[:, 0]
+    if log_scale:
+        z = jnp.log(jnp.maximum(x, jnp.float32(EPS)))  # scorer z (tpe)
+    else:
+        z = x
+
+    # --- score: online logsumexp over both mixture regions ------------
+    f = jnp.stack([z * z, z, jnp.ones_like(z)], axis=-1)  # [TC, 3]
+    ll_b = _region_logsumexp(f, p_ref, 0, KB, TKB, lead=0, fma=fma)
+    ll_a = _region_logsumexp(f, p_ref, KB, KA, TKA, lead=0, fma=fma)
+    score = ll_b - ll_a
+
+    # --- select: running winner + EI partials --------------------------
+    neg_inf = jnp.float32(-jnp.inf)
+    cidx = jax.lax.broadcasted_iota(jnp.int32, (tc, 1), 0)[:, 0] + i * tc
+    valid = cidx < n_cand
+    big_i = jnp.int32(2 ** 30)
+    sw = jnp.where(valid, score, neg_inf)
+    tile_best = jnp.max(sw)
+    tile_arg = jnp.min(jnp.where(sw == tile_best, cidx, big_i))
+    tile_val = jnp.sum(jnp.where(cidx == tile_arg, x, 0.0))
+    # sanitized scores for the EI reductions (tpe_device._ei_diag parity);
+    # padding lanes are -inf so they contribute exactly zero mass
+    sd = jnp.clip(
+        jnp.nan_to_num(score, nan=-1e30, posinf=1e30, neginf=-1e30),
+        -1e30, 1e30,
+    )
+    tile_m = jnp.max(jnp.where(valid, sd, jnp.float32(NEG_BIG)))
+
+    prev = acc_ref[0, 0]                   # [8, 128]
+    first = i == 0
+    best0 = jnp.where(first, neg_inf, prev[0, _ACC_BEST])
+    val0 = jnp.where(first, 0.0, prev[0, _ACC_VAL])
+    arg0 = jnp.where(first, 0.0, prev[0, _ACC_ARG])
+    m0 = jnp.where(first, jnp.float32(NEG_BIG), prev[0, _ACC_M])
+    s0 = jnp.where(first, 0.0, prev[0, _ACC_S])
+    top0 = jnp.where(first, neg_inf, prev[1, :])  # [128]
+
+    upd = tile_best > best0                # strict: ties keep the earlier
+    best1 = jnp.where(upd, tile_best, best0)
+    val1 = jnp.where(upd, tile_val, val0)
+    arg1 = jnp.where(upd, tile_arg.astype(jnp.float32), arg0)
+    m1 = jnp.maximum(m0, tile_m)
+    s1 = s0 * jnp.exp(m0 - m1) + jnp.sum(
+        jnp.where(valid, jnp.exp(sd - m1), 0.0)
+    )
+
+    # running top-n_top: merge the carried set with this tile's
+    # sanitized scores by n_top rounds of (max, first-index mask-out) —
+    # no lax.top_k/sort inside the kernel (Mosaic-safe)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)[0]
+    carried = jnp.where(lane < n_top, top0, neg_inf)
+    combined = jnp.concatenate([carried, jnp.where(valid, sd, neg_inf)])
+    M = combined.shape[0]
+    mi = jax.lax.broadcasted_iota(jnp.int32, (M, 1), 0)[:, 0]
+
+    def sel_step(n, carry):
+        vals, tops = carry
+        cur = jnp.max(vals)
+        firsti = jnp.min(jnp.where(vals == cur, mi, big_i))
+        vals = jnp.where(mi == firsti, neg_inf, vals)
+        tops = jnp.where(lane == n, cur, tops)
+        return vals, tops
+
+    _, top1 = jax.lax.fori_loop(
+        0, n_top, sel_step, (combined, jnp.full((128,), neg_inf))
+    )
+
+    row = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
+    lane2 = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 1)
+    row0 = jnp.where(
+        lane2 == _ACC_BEST, best1,
+        jnp.where(lane2 == _ACC_VAL, val1,
+                  jnp.where(lane2 == _ACC_ARG, arg1,
+                            jnp.where(lane2 == _ACC_M, m1,
+                                      jnp.where(lane2 == _ACC_S, s1, 0.0)))),
+    )
+    acc_ref[0, 0] = jnp.where(
+        row == 0, row0, jnp.where(row == 1, top1[None, :], 0.0)
+    )
+
+
+def _default_interpret() -> bool:
+    """Interpreter off-TPU (the CPU/CI path), Mosaic on real TPUs.
+    ``HYPEROPT_TPU_FUSED_INTERPRET=0/1`` overrides — the partition
+    audit traces with 0 so the ``pallas_call`` primitive (and its
+    operand shardings) stay visible in the jaxpr, and the bench quick
+    smoke forces 1."""
+    v = env_bool("HYPEROPT_TPU_FUSED_INTERPRET")
+    if v is not None:
+        return v
+    return jax.default_backend() != "tpu"
+
+
+def fused_suggest_pallas(
+    u_comp,        # [L, C] f32: raw component-selection uniforms
+                   #   (draw_in_kernel) or gmm_sample's candidates (not)
+    u_val,         # [L, C] f32: raw truncated-normal uniforms
+                   #   (draw_in_kernel only; pass zeros otherwise)
+    draw_params,   # [L, 7, Kb] f32 from draw_param_rows (vmapped);
+                   #   zeros when draw_in_kernel=False
+    params_pair,   # [L, 3, Kb+Ka] f32 from ops.score.pair_params
+    k_below: int,  # static: Kb — the draw mixture's component count too
+    k: int,        # static: suggestions per label (C = k * n_cand)
+    n_top: int = 16,
+    tc: int = 512,
+    tk: int = 512,
+    log_scale: bool = False,
+    draw_in_kernel: bool = False,
+    interpret=None,
+    fma=None,
+):
+    """The fused suggest inner loop as ONE Pallas launch.
+
+    ``draw_in_kernel=False`` (the bit-exact default): ``u_comp`` carries
+    the candidates ``gmm_sample`` drew inside the same fused program and
+    the kernel fuses scoring → top-k → EI reductions over them.
+    ``draw_in_kernel=True`` (opt-in, :func:`resolve_fused_draw`): the
+    kernel also performs the draw from raw uniforms — candidate values
+    then match the unfused draw only up to FMA-contraction ulps (the
+    documented tolerance).
+
+    Returns ``(win, best_idx, seg_m, seg_s, seg_top)``:
+
+    - ``win`` ``[L, k]`` — the winning candidate VALUES (raw space),
+      exactly ``cands[argmax(score)]`` of the unfused path;
+    - ``best_idx`` ``[L, k]`` i32 — the winning candidate's index
+      within its ``n_cand`` segment (tests/debugging);
+    - ``seg_m``/``seg_s`` ``[L, k]`` — per-segment online-logsumexp
+      partials over the sanitized scores;
+    - ``seg_top`` ``[L, k, n_top]`` — per-segment top-``n_top``
+      sanitized scores (−inf padded).
+
+    Combine the partials with :func:`ei_from_partials` for the
+    ``_ei_diag``-parity per-label reductions.
+    """
+    if fma is None:
+        fma = resolve_fma("batched")
+    if interpret is None:
+        interpret = _default_interpret()
+    return _fused_suggest_pallas(
+        u_comp, u_val, draw_params, params_pair, k_below, k, n_top, tc, tk,
+        log_scale, draw_in_kernel, interpret, fma,
+    )
+
+
+@partial(jax.jit, static_argnames=(
+    "k_below", "k", "n_top", "tc", "tk", "log_scale", "draw_in_kernel",
+    "interpret", "fma",
+))
+def _fused_suggest_pallas(
+    u_comp, u_val, draw_params, params_pair, k_below: int, k: int,
+    n_top: int, tc, tk, log_scale, draw_in_kernel, interpret, fma,
+):
+    L, C = u_comp.shape
+    if C % k:
+        raise ValueError(f"candidate count {C} not divisible by k={k}")
+    n_cand = C // k
+    n_top = min(int(n_top), n_cand * k)
+    if n_top > 128:
+        raise ValueError(f"n_top={n_top} exceeds the accumulator row")
+
+    # scoring regions: the pallas_gmm pad/tile scheme, bit-compatible
+    # with pair_score_pallas_batched at the same (tc, tk)
+    tkb = _region_tile(k_below, tk)
+    tka = _region_tile(params_pair.shape[2] - k_below, tk)
+    params_pair, KB, KA = _pad_regions(params_pair, k_below, tkb, tka)
+
+    # draw block: rows padded 7 → 8 (f32 sublane tile), components
+    # lane-padded with the cdf's edge value (total — never selected,
+    # since t < total strictly) and zeros elsewhere (never gathered,
+    # comp is clipped to k_real-1 < Kb)
+    KD = max(128, -(-k_below // 128) * 128)
+    pad_k = KD - k_below
+    dp = jnp.pad(draw_params, ((0, 0), (0, 1), (0, 0)))        # [L, 8, Kb]
+    if pad_k:
+        cdf_tail = jnp.repeat(dp[:, :1, -1:], pad_k, axis=2)    # edge value
+        tail = jnp.concatenate(
+            [cdf_tail, jnp.zeros((L, 7, pad_k), dp.dtype)], axis=1
+        )
+        dp = jnp.concatenate([dp, tail], axis=2)                # [L, 8, KD]
+
+    # candidate tiles: pad each n_cand segment up to the tile multiple
+    # AFTER the u-streams were drawn at exactly k*n_cand — padding
+    # consumes no uniforms, keeping the draw aligned with gmm_sample
+    tc_eff = min(tc, -(-n_cand // 8) * 8)
+    n_t = -(-n_cand // tc_eff)
+    cp = n_t * tc_eff - n_cand
+    uv = jnp.stack([u_comp, u_val], axis=-1).reshape(L, k, n_cand, 2)
+    if cp:
+        uv = jnp.pad(uv, ((0, 0), (0, 0), (0, cp), (0, 0)))
+
+    acc = pl.pallas_call(
+        partial(
+            _fused_kernel, KD=KD, KB=KB, KA=KA, TKB=tkb, TKA=tka,
+            k_real=k_below, n_cand=n_cand, tc=tc_eff, n_top=n_top,
+            log_scale=log_scale, draw_in_kernel=draw_in_kernel, fma=fma,
+        ),
+        out_shape=jax.ShapeDtypeStruct((L, k, 8, 128), jnp.float32),
+        grid=(L, k, n_t),
+        in_specs=[
+            pl.BlockSpec((1, 1, tc_eff, 2), lambda l, j, i: (l, j, i, 0)),
+            pl.BlockSpec((1, 8, KD), lambda l, j, i: (l, 0, 0)),
+            pl.BlockSpec((1, 3, KB + KA), lambda l, j, i: (l, 0, 0)),
+        ],
+        # constant over the candidate-tile dim: the block stays resident
+        # and accumulates across tiles (the flash-attention revisit
+        # pattern) — written back once per (l, j)
+        out_specs=pl.BlockSpec((1, 1, 8, 128), lambda l, j, i: (l, j, 0, 0)),
+        interpret=interpret,
+    )(uv, dp, params_pair)
+
+    win = acc[:, :, 0, _ACC_VAL]
+    best_idx = acc[:, :, 0, _ACC_ARG].astype(jnp.int32)
+    seg_m = acc[:, :, 0, _ACC_M]
+    seg_s = acc[:, :, 0, _ACC_S]
+    seg_top = acc[:, :, 1, :n_top]
+    return win, best_idx, seg_m, seg_s, seg_top
+
+
+def ei_from_partials(seg_m, seg_s, seg_top, n_cand_total: int, n_top: int):
+    """Combine the kernel's per-(label, segment) partials into the
+    per-label EI reductions of ``tpe_device._ei_diag``: ``(max,
+    log-mean-exp, top-k softmax mass)`` each ``[L]``.
+
+    ``seg_m``/``seg_s`` are per-segment online-logsumexp states over the
+    sanitized scores; the cross-segment combine is the standard
+    max-rebased merge (exact for the max, standard fp association for
+    the sum — the EI columns are telemetry, compared with tolerance).
+    ``seg_top`` per-segment top sets contain the global top set as a
+    subset, so a top-k over their concatenation is the global top-k.
+    """
+    m_star = jnp.max(seg_m, axis=1)                       # [L]
+    s_tot = jnp.sum(seg_s * jnp.exp(seg_m - m_star[:, None]), axis=1)
+    lse = m_star + jnp.log(jnp.maximum(s_tot, 1e-300))
+    lme = lse - jnp.float32(np.log(n_cand_total))
+    L = seg_m.shape[0]
+    flat = seg_top.reshape(L, -1)
+    kk = min(int(n_top), n_cand_total, flat.shape[1])
+    topk = jax.lax.top_k(flat, kk)[0]
+    mass = jnp.sum(jnp.exp(topk - lse[:, None]), axis=1)
+    return m_star, lme, mass
+
+
+# ---------------------------------------------------------------------
+# Tier resolution (resolve_fma-style; see ops.score.effective_scorer)
+# ---------------------------------------------------------------------
+
+# process-wide measured default, set by the TPU timing probe in
+# hyperopt_tpu.algos.tpe (None until a probe or set_default_fused call)
+_fused_measured_default = None
+
+
+def set_default_fused(value) -> None:
+    """Record the TPU probe's verdict (True/False) — or ``None`` to
+    clear it (tests)."""
+    global _fused_measured_default
+    _fused_measured_default = None if value is None else bool(value)
+
+
+def resolve_fused() -> bool:
+    """Should the auto-selected scorer use the fused mega-kernel?
+
+    Resolution order (the ``resolve_fma`` pattern):
+
+    1. ``HYPEROPT_TPU_FUSED=0/1`` env override;
+    2. the measured default (:func:`set_default_fused`, written by the
+       per-process TPU probe in ``algos.tpe``);
+    3. off — the fused tier is **opt-in**: its winner can differ from
+       the XLA tier's at float-associativity near-ties, so the default
+       path stays bit-exact (docs/API.md "Scorer tiers").
+
+    An explicit ``HYPEROPT_TPU_SCORER=fused`` bypasses this resolver
+    entirely (forced scorers are honored verbatim).
+    """
+    v = env_bool("HYPEROPT_TPU_FUSED")
+    if v is not None:
+        return v
+    if _fused_measured_default is not None:
+        return _fused_measured_default
+    return False
+
+
+def resolve_fused_draw() -> bool:
+    """Should the fused kernel ALSO perform the candidate draw in-kernel
+    (``HYPEROPT_TPU_FUSED_DRAW=1``)?  Default off: the in-kernel draw's
+    values can differ from ``gmm_sample``'s in the last ulp (XLA FMA
+    contraction differs between program contexts), so the bit-exact
+    default streams ``gmm_sample``'s own candidates through the kernel
+    instead.  Tolerance when on: candidate values within 1-2 ulp of the
+    unfused draw; at score near-ties the winner index may differ."""
+    return bool(env_bool("HYPEROPT_TPU_FUSED_DRAW"))

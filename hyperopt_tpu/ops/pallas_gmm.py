@@ -49,6 +49,18 @@ from jax.experimental import pallas as pl
 NEG_BIG = -1e30
 
 
+def env_bool(name: str):
+    """Tri-state env flag: True/False when set (truthy strings are
+    ``1/true/yes/on``), None when unset — the one parser every kernel
+    resolver shares."""
+    import os
+
+    v = os.environ.get(name)
+    if v is None:
+        return None
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
 def _mix_update(comp, m, s):
     tile_max = jnp.max(comp, axis=1)
     new_m = jnp.maximum(m, tile_max)
@@ -203,13 +215,11 @@ def resolve_fma(kernel: str = "batched") -> bool:
        silent-MXU;
     4. the MXU path.
     """
-    import os
-
     if kernel not in ("batched", "unbatched"):
         raise ValueError(kernel)
-    v = os.environ.get("HYPEROPT_TPU_PALLAS_FMA")
+    v = env_bool("HYPEROPT_TPU_PALLAS_FMA")
     if v is not None:
-        return v.strip().lower() in ("1", "true", "yes", "on")
+        return v
     own, other = (
         (_fma_measured_default, _fma_measured_default_unbatched)
         if kernel == "batched"
@@ -220,6 +230,34 @@ def resolve_fma(kernel: str = "batched") -> bool:
     if other is not None:
         return other
     return False
+
+
+def resolve_fma_basis(kernel: str = "batched") -> str:
+    """WHERE :func:`resolve_fma`'s answer for ``kernel`` comes from:
+    ``"env"`` (HYPEROPT_TPU_PALLAS_FMA pin), ``"measured"`` (this
+    kernel's own timing probe), ``"other_kernel"`` (the single-probe
+    fallback — only the sibling kernel was measured), or
+    ``"default_mxu"`` (nothing probed).  Reported next to the resolved
+    booleans in the bench smoke block so two artifacts showing
+    different defaults are EXPLAINABLE (probe outcomes can legitimately
+    differ per kernel and per capture host) instead of silently
+    contradictory — the ISSUE-14 ``pallas_fma_default`` satellite."""
+    import os
+
+    if kernel not in ("batched", "unbatched"):
+        raise ValueError(kernel)
+    if os.environ.get("HYPEROPT_TPU_PALLAS_FMA") is not None:
+        return "env"
+    own, other = (
+        (_fma_measured_default, _fma_measured_default_unbatched)
+        if kernel == "batched"
+        else (_fma_measured_default_unbatched, _fma_measured_default)
+    )
+    if own is not None:
+        return "measured"
+    if other is not None:
+        return "other_kernel"
+    return "default_mxu"
 
 
 def _default_fma(batched: bool = True) -> bool:

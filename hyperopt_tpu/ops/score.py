@@ -82,15 +82,22 @@ PALLAS_MIN_K = 6144
 def effective_scorer(scorer: str, k_total: int) -> str:
     """Static scorer choice per mixture size (shapes are trace-time).
 
-    The K-crossover only applies to the *auto-selected* scorer; an
-    explicit HYPEROPT_TPU_SCORER force is honored verbatim (so the
-    Pallas path can be exercised on small histories deliberately).
+    Tiers (docs/API.md "Scorer tiers"): ``xla`` (chunked MXU matmul +
+    full-row logsumexp), ``pallas`` (hand-tiled online-logsumexp
+    kernel), ``fused`` (the :mod:`~hyperopt_tpu.ops.pallas_fused`
+    mega-kernel — draw → score → top-k in one launch), ``exact``
+    (normalized lpdf path).  The K-crossover only applies to the
+    *auto-selected* scorer — below ``PALLAS_MIN_K`` both hand kernels
+    lose to XLA's own tiling (the [chunk, K] intermediate still fits
+    VMEM), so ``pallas``/``fused`` demote to ``xla``; an explicit
+    HYPEROPT_TPU_SCORER force is honored verbatim (so the hand kernels
+    can be exercised on small histories deliberately).
     """
     import os
 
     if os.environ.get("HYPEROPT_TPU_SCORER"):
         return scorer
-    if scorer == "pallas" and k_total < PALLAS_MIN_K:
+    if scorer in ("pallas", "fused") and k_total < PALLAS_MIN_K:
         return "xla"
     return scorer
 
@@ -110,7 +117,15 @@ def pair_score_cost(n_cand: int, k_total: int, scorer: str) -> dict:
       matrix: at production K this makes it **bandwidth-bound**;
     - the **Pallas** kernels accumulate the logsumexp online in VMEM
       and never materialize comp: traffic is just candidates, params,
-      and output.
+      and output;
+    - the **fused** mega-kernel (:mod:`hyperopt_tpu.ops.pallas_gmm`'s
+      online logsumexp extended with in-launch draw + top-k selection,
+      :mod:`hyperopt_tpu.ops.pallas_fused`) additionally keeps the
+      candidate and score vectors in VMEM between stages: ZERO [C, K]
+      round trips AND no candidate/score round trip — traffic is the
+      u-streams (or streamed candidates), the params block, and the
+      [k]-winner accumulators.  The draw/select stages add ~O(C)
+      transform flops.
 
     ``hyperopt_tpu.profiling`` uses this for its analytical per-family
     cost fallback; the XLA model is an upper bound XLA's fusion may
@@ -119,9 +134,18 @@ def pair_score_cost(n_cand: int, k_total: int, scorer: str) -> dict:
     C, K = float(n_cand), float(k_total)
     mxu = 2.0 * 3.0 * C * K
     flops = mxu + 4.0 * C * K
+    eff = effective_scorer(scorer, int(k_total))
+    if eff == "fused":
+        # truncated-normal transform + inverse-CDF select + running
+        # winner/EI updates, all O(C)
+        flops += 40.0 * C
+        # two u-streams in, params in, [k] winner accumulators out
+        # (negligible) — the candidates/scores never touch HBM
+        nbytes = 4.0 * (2.0 * C + 3.0 * K)
+        return {"flops": flops, "mxu_flops": mxu, "bytes": nbytes}
     # z read + features + output, params [3, K]
     nbytes = 4.0 * (3.0 * C + 3.0 * K)
-    if effective_scorer(scorer, int(k_total)) != "pallas":
+    if eff != "pallas":
         nbytes += 2.0 * C * K * 4.0  # comp matrix write + read
     return {"flops": flops, "mxu_flops": mxu, "bytes": nbytes}
 

@@ -166,8 +166,15 @@ def _cont_request_cost(args, statics) -> dict:
     flops = 16.0 * capt + L * (32.0 * cap + 12.0 * C)
     # input residency: obs+pos [L,cap] x2, losses+keep+ranks [CAPT]
     bytes_moved = 2.0 * L * cap * _F32 + 3.0 * capt * _F32
-    # candidates: written by the draw, re-read by the scorer
-    bytes_moved += 2.0 * L * C * _F32
+    from .ops.score import effective_scorer
+    eff = effective_scorer(statics.get("scorer", "xla"), K)
+    if eff != "fused" or quantized:
+        # candidates: written by the draw, re-read by the scorer.  The
+        # fused mega-kernel streams them through VMEM instead (its own
+        # u-stream/candidate traffic is charged by pair_score_cost) —
+        # charging the round trip here too would double-count it and
+        # silently skew the roofline attribution for the new kernel.
+        bytes_moved += 2.0 * L * C * _F32
     mxu_flops = 0.0
     if quantized and n_buckets > 0:
         # bucket-grid scoring: exact quantized lpdf on a [B] grid per

@@ -1,0 +1,462 @@
+"""BENCH_TPU_fused.json / BENCH_TPU_fused.quick.json generator.
+
+The ISSUE-14 acceptance artifact for the fused Pallas mega-kernel
+(sampling → scoring → top-k in one launch, ``ops/pallas_fused.py``):
+
+- **parity**: the fused kernel against the unfused reference chain
+  (``gmm_sample`` → ``pair_score`` → argmax) across the
+  broken-space-adjacent shape grid — ``k_below`` edges,
+  single-component mixtures, NEG_BIG padding rows, bounded/unbounded,
+  log-scale, and a 100k-history tiled case — asserting BITWISE winner
+  identity in the default exact-draw mode and recording the EI-diag
+  deltas;
+- **trajectory**: ``fmin`` with the fused tier forced vs the default
+  unfused path, same seeds, asserted trial-for-trial identical;
+- **recompilation**: the fused tier holds the one-trace-per-(bucket,
+  family) budget over a growing-history CPU run
+  (``RecompilationAuditor``);
+- **tiling**: the 100k-history shape's tile decomposition on record
+  (component tiles, candidate tiles, VMEM residency of the parameter
+  block) — the structural proof the mega-kernel covers the shape that
+  ``BENCH_TPU_100k.json`` still reports a null headline for;
+- **headline** (full runs on TPU hardware only): fused vs unfused
+  EI-evals/s at the 10k/100k shapes; quick/CPU runs stamp the PR 7
+  null-with-reason contract instead.
+
+Every quick-artifact guard is STRUCTURAL (bitwise-equality flags,
+counts, coverage) — never absolute milliseconds (sandbox latency
+swings ~30x between sessions; see tests/test_bench_artifacts.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+import numpy as np  # noqa: E402
+
+
+# (name, kb_real, ka_real, k, n_cand, log_scale, lo, hi) — the
+# broken-space-adjacent grid of the ISSUE-14 test satellite; the 100k
+# case uses the real 100k-history bucket size (ka = 2**17 + 1 with the
+# +1 prior component) at a small candidate count so interpret mode
+# stays tractable
+SHAPE_GRID = [
+    ("kb_edge_prior_only", 0, 40, 1, 24, False, -2.0, 2.0),
+    ("kb_edge_one_obs", 1, 7, 2, 100, False, -2.0, 2.0),
+    ("single_component_above", 6, 1, 1, 64, False, -2.0, 2.0),
+    ("unbounded_normal", 5, 40, 2, 50, False, -np.inf, np.inf),
+    ("log_scale_bounded", 25, 300, 4, 33, True, -3.0, 1.0),
+    ("padding_heavy", 3, 17, 1, 24, False, -4.0, 4.0),
+    ("tiled_100k", 25, 2 ** 17, 1, 256, False, -2.0, 2.0),
+]
+
+
+def _mk_mixture(rng, k_real, pad):
+    """A mixture with ``k_real`` live components and ``pad`` NEG_BIG
+    padding slots (weight exactly 0), prior-style: k_real counts the
+    observation components, +1 prior is always live."""
+    import jax.numpy as jnp
+
+    n = k_real + 1 + pad  # +1: the prior component is always present
+    w = rng.uniform(0.1, 1.0, n).astype(np.float32)
+    if pad:
+        w[-pad:] = 0.0
+    w = w / w.sum()
+    mu = rng.normal(0, 2, n).astype(np.float32)
+    s = rng.uniform(0.3, 2.0, n).astype(np.float32)
+    return jnp.asarray(w), jnp.asarray(mu), jnp.asarray(s)
+
+
+def _parity_case(name, kb_real, ka_real, k, n_cand, log_scale, lo, hi,
+                 seed=0, L=2, draw_in_kernel=False):
+    """One shape-grid case: fused kernel vs the unfused reference chain.
+    Returns the per-case record (bitwise flags, diag deltas, tiling)."""
+    import jax
+    import jax.numpy as jnp
+
+    from hyperopt_tpu.algos.tpe_device import _ei_diag
+    from hyperopt_tpu.ops import gmm as gmm_ops
+    from hyperopt_tpu.ops.pallas_fused import (
+        draw_param_rows,
+        ei_from_partials,
+        fused_suggest_pallas,
+    )
+    from hyperopt_tpu.ops.score import pair_params, pair_score
+
+    rng = np.random.default_rng(seed)
+    lo = np.float32(lo)
+    hi = np.float32(hi)
+    C = k * n_cand
+    keys = jax.random.split(jax.random.PRNGKey(seed), L)
+    wins_ref, cands, u1s, u2s, dps, Ps, scores = [], [], [], [], [], [], []
+    for li in range(L):
+        below = _mk_mixture(rng, kb_real, pad=3)
+        above = _mk_mixture(rng, ka_real, pad=5)
+        key = keys[li]
+        cand = gmm_ops.gmm_sample(
+            key, *below, lo, hi, np.float32(0.0), C, log_scale
+        )
+        z = jnp.log(jnp.maximum(cand, 1e-12)) if log_scale else cand
+        P = pair_params(*below, *above)
+        kb = below[0].shape[0]
+        sc = np.asarray(pair_score(z, P, kb))
+        cd = np.asarray(cand).reshape(k, n_cand)
+        idx = np.argmax(sc.reshape(k, n_cand), axis=1)
+        wins_ref.append(cd[np.arange(k), idx])
+        scores.append(sc)
+        k_comp, k_val = jax.random.split(key)
+        u1s.append(jax.random.uniform(k_comp, (C,), jnp.float32))
+        u2s.append(jax.random.uniform(k_val, (C,), jnp.float32))
+        dps.append(draw_param_rows(*below, lo, hi))
+        Ps.append(P)
+        cands.append(cand)
+    kb = kb_real + 1 + 3
+    if draw_in_kernel:
+        a0, a1, a2 = jnp.stack(u1s), jnp.stack(u2s), jnp.stack(dps)
+    else:
+        a0 = jnp.stack(cands)
+        a1 = jnp.zeros_like(a0)
+        a2 = jnp.zeros((L, 7, kb), jnp.float32)
+    win, _idx, seg_m, seg_s, seg_top = fused_suggest_pallas(
+        a0, a1, a2, jnp.stack(Ps), k_below=kb, k=k, log_scale=log_scale,
+        draw_in_kernel=draw_in_kernel,
+    )
+    wins_ref = np.stack(wins_ref).astype(np.float32)
+    win = np.asarray(win)
+    r_max, r_lme, r_mass = (
+        np.asarray(v) for v in _ei_diag(jnp.asarray(np.stack(scores)))
+    )
+    n_top = min(16, C)
+    g_max, g_lme, g_mass = (
+        np.asarray(v)
+        for v in ei_from_partials(seg_m, seg_s, seg_top, C, n_top)
+    )
+    diag_err = float(max(
+        np.max(np.abs(r_max - g_max)),
+        np.max(np.abs(r_lme - g_lme)),
+        np.max(np.abs(r_mass - g_mass)),
+    ))
+    return {
+        "case": name,
+        "k_below": int(kb),
+        "k_total": int(np.stack(Ps).shape[-1]),
+        "k": int(k),
+        "n_cand": int(n_cand),
+        "log_scale": bool(log_scale),
+        "draw_in_kernel": bool(draw_in_kernel),
+        "winner_bitwise_match": bool(np.array_equal(wins_ref, win)),
+        "winner_max_abs_err": float(np.max(np.abs(wins_ref - win))),
+        "diag_max_abs_err": diag_err,
+    }
+
+
+def _trajectory_check(n_trials=40, seed=7):
+    """fmin with the fused tier forced vs the default unfused path:
+    identical trial docs, trial for trial, at the same seeds.  Runs in
+    subprocesses so the scorer env force cannot leak into this
+    process's jit caches."""
+    import subprocess
+
+    code = """
+import os, json, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+scorer = sys.argv[1]
+if scorer != "default":
+    os.environ["HYPEROPT_TPU_SCORER"] = scorer
+import numpy as np
+from functools import partial
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu.algos import tpe
+space = {
+    "u": hp.uniform("u", -2.0, 2.0),
+    "lu": hp.loguniform("lu", -4.0, 2.0),
+    "n": hp.normal("n", 0.0, 1.0),
+    "c": hp.choice("c", [0, 1, 2]),
+}
+trials = Trials()
+fmin(lambda c: float(c["u"]**2 + c["n"]**2 + 0.1*c["c"] + 0.01*c["lu"]),
+     space, algo=partial(tpe.suggest, n_EI_candidates=24),
+     max_evals=int(sys.argv[2]), trials=trials,
+     rstate=np.random.default_rng(int(sys.argv[3])),
+     show_progressbar=False, verbose=False, max_speculation=0)
+out = [
+    {k: [float(x) for x in v] for k, v in t["misc"]["vals"].items()}
+    for t in trials.trials
+]
+print(json.dumps(out))
+"""
+
+    def run(scorer):
+        r = subprocess.run(
+            [sys.executable, "-c", code, scorer, str(n_trials), str(seed)],
+            capture_output=True, text=True,
+        )
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"trajectory arm {scorer!r} failed:\n{r.stderr[-2000:]}"
+            )
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    ref = run("default")
+    fused = run("fused")
+    return {
+        "n_trials": n_trials,
+        "seed": seed,
+        "identical": ref == fused,
+        "first_divergence": next(
+            (i for i, (a, b) in enumerate(zip(ref, fused)) if a != b), None
+        ),
+    }
+
+
+def _recompile_check(n_trials=80):
+    """The fused tier under the one-trace-per-(bucket, family) budget."""
+    from hyperopt_tpu.analysis.program_lint import audit_tpe_run
+
+    prev = os.environ.get("HYPEROPT_TPU_SCORER")
+    os.environ["HYPEROPT_TPU_SCORER"] = "fused"
+    try:
+        aud = audit_tpe_run(n_trials=n_trials)
+    finally:
+        if prev is None:
+            os.environ.pop("HYPEROPT_TPU_SCORER", None)
+        else:
+            os.environ["HYPEROPT_TPU_SCORER"] = prev
+    return {
+        "n_trials": n_trials,
+        "n_traces": aud.n_traces,
+        "n_programs": aud.n_programs,
+        "buckets": [[int(b), int(n)] for b, n in aud.bucket_summary()],
+        "violations": [str(d) for d in aud.diagnostics()],
+        "one_trace_per_bucket": not aud.diagnostics(),
+    }
+
+
+def _tiling_100k():
+    """The 100k-history shape's tile decomposition — structural proof
+    the mega-kernel's grid covers the shape, plus the VMEM residency
+    of the parameter block."""
+    from hyperopt_tpu.ops import parzen as parzen_ops
+    from hyperopt_tpu.ops.pallas_gmm import _region_tile
+
+    n_history = 100_000
+    cap = parzen_ops.bucket(n_history)          # 131072
+    lf = 25
+    cap_b = parzen_ops.bucket(lf)               # 32
+    kb = cap_b + 1
+    ka = cap + 1
+    tk = 512
+    tkb = _region_tile(kb, tk)
+    tka = _region_tile(ka, tk)
+    KB = kb + (-kb) % tkb
+    KA = ka + (-ka) % tka
+    n_cand, tc = 8192, 512
+    return {
+        "n_history": n_history,
+        "capt_bucket": cap,
+        "k_below": kb,
+        "k_above": ka,
+        "k_total": kb + ka,
+        "region_tiles": {"below": tkb, "above": tka},
+        "component_tiles": {"below": KB // tkb, "above": KA // tka},
+        "n_cand": n_cand,
+        "candidate_tile": tc,
+        "candidate_tiles": -(-n_cand // tc),
+        "params_vmem_bytes": 3 * (KB + KA) * 4,
+        "params_vmem_frac_of_16mb": round(
+            3 * (KB + KA) * 4 / (16 * 2 ** 20), 4
+        ),
+        "covered": True,
+    }
+
+
+def _headline(platform: str):
+    """The PR 7 null contract: the fused-vs-unfused EI-evals/s headline
+    is measured only on TPU hardware (Mosaic lowering); quick/CPU runs
+    stamp null with the reason."""
+    if platform == "tpu":  # pragma: no cover - capture host only
+        return _measure_headline_tpu()
+    return {
+        "value": None,
+        "unit": "EI_evals/s",
+        "vs_unfused": None,
+        "unmeasured_reason": (
+            "fused-kernel throughput is unavailable off-TPU (Mosaic "
+            "lowering requires real hardware; this artifact was "
+            "captured interpret-mode on CPU) — parity/trajectory/"
+            "tiling guards above are the CPU-checkable contract; "
+            "capture on the TPU host re-stamps this field (target: "
+            ">=10x the 230.7 G EI-evals/s BENCH_TPU.json headline, "
+            "non-null double-digit-MFU BENCH_TPU_100k.json headline)"
+        ),
+    }
+
+
+def _measure_headline_tpu():  # pragma: no cover - capture host only
+    """In-graph fused vs unfused A/B at the BENCH_TPU shapes (10k
+    history, 8192 candidates)."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+
+    from hyperopt_tpu.ops import pallas_fused
+    from hyperopt_tpu.ops import parzen as parzen_ops
+    from hyperopt_tpu.ops.pallas_gmm import pair_score_pallas_batched
+    from hyperopt_tpu.ops.score import pair_params
+
+    rng = np.random.default_rng(0)
+    out = {}
+    best = 0.0
+    for n_hist in (10_000, 100_000):
+        cap = parzen_ops.bucket(n_hist)
+        obs = jnp.asarray(rng.normal(size=cap).astype(np.float32))
+        wa, ma, sa = parzen_ops.adaptive_parzen_normal_padded(
+            obs, n_hist, jnp.float32(1.0), jnp.float32(0.0),
+            jnp.float32(10.0), 25,
+        )
+        wb, mb, sb = parzen_ops.adaptive_parzen_normal_padded(
+            obs[:32], 25, jnp.float32(1.0), jnp.float32(0.0),
+            jnp.float32(10.0), 25,
+        )
+        params = pair_params(wb, mb, sb, wa, ma, sa)[None]
+        kb = int(wb.shape[0])
+        k_real = (25 + 1) + (n_hist + 1)
+        n_cand = 8192
+        z = jnp.asarray(
+            rng.normal(size=(1, n_cand)).astype(np.float32)
+        )
+        rows = jnp.zeros((1, 7, kb), jnp.float32)
+
+        def timed(fused, iters=8):
+            @jax.jit
+            def chain(z0):
+                def body(_, c):
+                    zc = z0 + c * jnp.float32(1e-7)
+                    if fused:
+                        win = pallas_fused._fused_suggest_pallas(
+                            zc, jnp.zeros_like(zc), rows, params, kb, 1,
+                            16, 512, 512, False, False, False,
+                            pallas_fused.resolve_fma("batched"),
+                        )[0]
+                        return win[0, 0] * jnp.float32(1e-7)
+                    s = pair_score_pallas_batched(zc, params, kb)
+                    idx = jnp.argmax(s, axis=1)
+                    return jnp.take_along_axis(zc, idx[:, None], 1)[
+                        0, 0
+                    ] * jnp.float32(1e-7)
+
+                return jax.lax.fori_loop(0, iters, body, jnp.float32(0.0))
+
+            jax.block_until_ready(chain(z))
+            t0 = _t.perf_counter()
+            jax.block_until_ready(chain(z))
+            return (_t.perf_counter() - t0) / iters
+
+        per_unfused = timed(False)
+        per_fused = timed(True)
+        rate = n_cand * k_real / per_fused
+        out[f"fused_h{n_hist}_gei_s"] = round(rate / 1e9, 2)
+        out[f"unfused_h{n_hist}_gei_s"] = round(
+            n_cand * k_real / per_unfused / 1e9, 2
+        )
+        best = max(best, rate)
+    out["value"] = round(best, 1)
+    out["unit"] = "EI_evals/s"
+    out["vs_unfused"] = round(
+        out["fused_h10000_gei_s"] / out["unfused_h10000_gei_s"], 3
+    )
+    out["unmeasured_reason"] = None
+    return out
+
+
+def run_fused(quick: bool = True) -> dict:
+    import jax
+
+    platform = jax.devices()[0].platform
+    t0 = time.time()
+    errors = []
+
+    parity = []
+    for case in SHAPE_GRID:
+        try:
+            parity.append(_parity_case(*case))
+        except Exception as e:  # pragma: no cover - diagnosed via report
+            errors.append(f"parity[{case[0]}]: {e!r}")
+    # the opt-in in-kernel-draw mode rides the grid once: tolerance
+    # class (ulp-level), never asserted bitwise
+    try:
+        parity.append(_parity_case(*SHAPE_GRID[1], draw_in_kernel=True))
+    except Exception as e:  # pragma: no cover
+        errors.append(f"parity[draw_in_kernel]: {e!r}")
+
+    exact = [p for p in parity if not p["draw_in_kernel"]]
+    trajectory = _trajectory_check(n_trials=30 if quick else 60)
+    recompile = _recompile_check(n_trials=60 if quick else 120)
+    tiling = _tiling_100k()
+    # a crashed tiled case lands in errors[], not exact — report it as
+    # a failure instead of raising out of the report generator
+    tiled_case = next(
+        (p for p in exact if p["case"] == "tiled_100k"), None
+    )
+
+    ok = (
+        not errors
+        and all(p["winner_bitwise_match"] for p in exact)
+        and all(p["diag_max_abs_err"] < 1e-3 for p in parity)
+        and trajectory["identical"]
+        and recompile["one_trace_per_bucket"]
+        and tiled_case is not None
+        and tiled_case["winner_bitwise_match"]
+    )
+    return {
+        "metric": "fused_suggest_kernel",
+        "quick": bool(quick),
+        "ok": bool(ok),
+        "platform": platform,
+        "interpret": platform != "tpu",
+        "n_parity_cases": len(parity),
+        "parity": parity,
+        "trajectory": trajectory,
+        "recompilation": recompile,
+        "tiling_100k": tiling,
+        "headline": _headline(platform),
+        "errors": errors,
+        "elapsed_s": round(time.time() - t0, 2),
+    }
+
+
+def write_report(report: dict, out_path: str) -> None:
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    quick = "--quick" in argv
+    out_path = (
+        "BENCH_TPU_fused.quick.json" if quick else "BENCH_TPU_fused.json"
+    )
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    report = run_fused(quick=quick)
+    write_report(report, out_path)
+    print(json.dumps({
+        "metric": report["metric"], "ok": report["ok"],
+        "artifact": out_path, "errors": report["errors"],
+    }))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())

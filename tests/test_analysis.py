@@ -1233,6 +1233,59 @@ def test_pl207_repinned_before_concat_clean():
     assert scan_partition_jaxpr(closed, "fixture") == []
 
 
+def _fused_kernel_program(mesh, pin_before_kernel: bool):
+    """A tiny program routing a dp-sharded candidate array into the
+    fused mega-kernel, with or without the replicated re-pin — the
+    PL209 fixture pair."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from hyperopt_tpu.ops import pallas_fused
+
+    rep = NamedSharding(mesh, PartitionSpec())
+    dp = NamedSharding(mesh, PartitionSpec(None, "dp"))
+    L, kb, C = 2, 4, 16
+    rows = jnp.zeros((L, 7, kb), jnp.float32)
+    p = jnp.zeros((L, 3, kb + 8), jnp.float32)
+
+    def prog(cand, rows, p):
+        cand = jax.lax.with_sharding_constraint(cand, rep)
+        rows = jax.lax.with_sharding_constraint(rows, rep)
+        p = jax.lax.with_sharding_constraint(p, rep)
+        cand = jax.lax.with_sharding_constraint(cand, dp)
+        if pin_before_kernel:
+            cand = jax.lax.with_sharding_constraint(cand, rep)
+        return pallas_fused.fused_suggest_pallas(
+            cand, jnp.zeros_like(cand), rows, p, k_below=kb, k=1,
+            interpret=False,
+        )[0]
+
+    return jax.make_jaxpr(prog)(jnp.zeros((L, C), jnp.float32), rows, p)
+
+
+def test_pl209_sharded_pallas_operand_fires(monkeypatch):
+    """A dp-sharded value reaching a pallas_call operand without a
+    replicated re-pin is the PR 11 miscompile class re-entering through
+    the new kernel — PL209 must fire."""
+    mesh = _mesh_or_skip()
+    monkeypatch.setenv("HYPEROPT_TPU_FUSED_INTERPRET", "0")
+    closed = _fused_kernel_program(mesh, pin_before_kernel=False)
+    diags = scan_partition_jaxpr(closed, "fixture")
+    assert "PL209" in _rules(diags), _rules(diags)
+    assert any("pallas_call" in d.message for d in diags)
+
+
+def test_pl209_repinned_pallas_operand_clean(monkeypatch):
+    """The _fused_winners discipline — every kernel operand re-pinned
+    replicated — audits clean."""
+    mesh = _mesh_or_skip()
+    monkeypatch.setenv("HYPEROPT_TPU_FUSED_INTERPRET", "0")
+    closed = _fused_kernel_program(mesh, pin_before_kernel=True)
+    diags = scan_partition_jaxpr(closed, "fixture")
+    assert "PL209" not in _rules(diags), _rules(diags)
+
+
 def test_pl206_pin_sites_static_seeded_violation(tmp_path):
     """A tpe_device.py whose pin sites lost their constraints is flagged
     without tracing anything (the refactor-guard tier of PL206)."""
@@ -1246,9 +1299,11 @@ def test_pl206_pin_sites_static_seeded_violation(tmp_path):
             jax.lax.with_sharding_constraint(1, 2)
         def _sharded_pair_apply():
             jax.lax.with_sharding_constraint(1, 2)
+        def _fused_winners():
+            pass
     """))
     diags = lint_pin_sites(repo_root=str(tmp_path))
-    assert _rules(diags) == ["PL206", "PL206", "PL206"]
+    assert _rules(diags) == ["PL206", "PL206", "PL206", "PL206"]
 
 
 def test_pl206_pin_sites_repo_clean():

@@ -497,6 +497,113 @@ def test_device_profile_artifact_fully_attributed():
 
 
 # ---------------------------------------------------------------------
+# BENCH_TPU_fused[.quick].json — the ISSUE-14 fused mega-kernel artifact
+# ---------------------------------------------------------------------
+
+FUSED = os.path.join(ROOT, "BENCH_TPU_fused.json")
+FUSED_QUICK = os.path.join(ROOT, "BENCH_TPU_fused.quick.json")
+
+
+@pytest.mark.skipif(
+    not (os.path.exists(FUSED) or os.path.exists(FUSED_QUICK)),
+    reason="no committed fused-kernel artifact",
+)
+def test_fused_kernel_artifact_structural_guards():
+    """The ISSUE-14 acceptance artifact (BENCH_TPU_fused.json, or its
+    interpret-mode .quick stand-in from ``bench.py --fused --quick``):
+    bitwise fused==reference agreement across the shape grid INCLUDING
+    the 100k-tiled case, trial-for-trial trajectory identity, a
+    one-trace-per-bucket dispatch budget, and the 100k tile coverage —
+    every guard STRUCTURAL (bitwise flags/counts/coverage), never
+    absolute milliseconds (sandbox latency swings ~30x between
+    sessions), and the TPU headline fields under the PR 7
+    null-with-reason contract."""
+    d = _load(FUSED if os.path.exists(FUSED) else FUSED_QUICK)
+    assert d["metric"] == "fused_suggest_kernel"
+    assert d["ok"] is True
+    assert d["errors"] == []
+    # the shape grid ran, and every default-mode (exact-draw) case is
+    # BITWISE identical to the unfused reference — including the
+    # 100k-history tiled case
+    exact = [p for p in d["parity"] if not p["draw_in_kernel"]]
+    assert len(exact) >= 6
+    for p in exact:
+        assert p["winner_bitwise_match"] is True, p["case"]
+        assert p["winner_max_abs_err"] == 0.0, p["case"]
+        assert p["diag_max_abs_err"] < 1e-3, p["case"]
+    tiled = next(p for p in exact if p["case"] == "tiled_100k")
+    assert tiled["k_total"] > 2 ** 17
+    # the opt-in in-kernel-draw arm is on record with its documented
+    # (ulp-class) tolerance — never asserted bitwise
+    inkernel = [p for p in d["parity"] if p["draw_in_kernel"]]
+    assert inkernel and all(
+        p["winner_max_abs_err"] < 1e-5 for p in inkernel
+    )
+    # trajectory identity, trial for trial, at fixed seeds
+    t = d["trajectory"]
+    assert t["identical"] is True and t["first_divergence"] is None
+    assert t["n_trials"] >= 30
+    # dispatch accounting: the fused tier holds the one-trace-per-
+    # (bucket, family) budget
+    r = d["recompilation"]
+    assert r["one_trace_per_bucket"] is True and r["violations"] == []
+    assert r["n_traces"] >= len(r["buckets"]) >= 1
+    # 100k tiling on record: the component axis is tiled (not a single
+    # monolithic block) and the parameter block fits VMEM
+    til = d["tiling_100k"]
+    assert til["covered"] is True
+    assert til["n_history"] == 100_000
+    assert til["component_tiles"]["above"] >= 2
+    assert til["params_vmem_frac_of_16mb"] < 0.5
+    # headline: measured on TPU (with the >=10x target trackable) or
+    # null WITH a reason pointing at the TPU capture path (PR 7)
+    h = d["headline"]
+    if h["value"] is None:
+        assert h["unmeasured_reason"] and "TPU" in h["unmeasured_reason"]
+    else:
+        assert d["platform"] == "tpu"
+        assert h["vs_unfused"] > 0
+        assert h["unmeasured_reason"] is None
+
+
+@needs_tpu_json
+@pytest.mark.skipif(
+    not os.path.exists(TPU_100K), reason="no committed 100k artifact"
+)
+def test_100k_null_reason_points_at_fused_artifact():
+    """The ISSUE-14 re-stamp: the 100k headline's unmeasured_reason now
+    names the fused artifact as the capture path instead of silently
+    staying stale."""
+    d = _load(TPU_100K)
+    if d["value"] is None:
+        assert "fused" in d["unmeasured_reason"]
+
+
+@needs_tpu_json
+def test_smoke_fma_defaults_carry_their_basis():
+    """The ISSUE-14 satellite: both pallas_fma entry points stamp
+    through the one resolve_fma resolver WITH the probe's measured
+    basis, so two artifacts can no longer show unexplained
+    contradictory defaults."""
+    d10 = _load(TPU)
+    basis = d10["smoke"]["pallas_fma_basis"]
+    assert set(basis) == {"batched", "unbatched"}
+    for v in basis.values():
+        assert v in ("env", "measured", "other_kernel", "default_mxu")
+    if os.path.exists(TPU_100K):
+        d100 = _load(TPU_100K)
+        b100 = d100["smoke"]["pallas_fma_basis"]
+        # per-kernel defaults must AGREE between artifacts unless a
+        # basis difference explains the split
+        for kernel, field in (
+            ("batched", "pallas_fma_default"),
+            ("unbatched", "pallas_fma_default_unbatched"),
+        ):
+            if basis[kernel] == b100[kernel] == "measured":
+                assert d10["smoke"][field] == d100["smoke"][field], kernel
+
+
+# ---------------------------------------------------------------------
 # FAILOVER_SERVE.json — the ISSUE-13 replica-plane failover artifact
 # ---------------------------------------------------------------------
 

@@ -1,0 +1,441 @@
+"""The fused Pallas suggest mega-kernel (ops/pallas_fused.py): interpret-
+mode parity against the unfused reference chain across the broken-space-
+adjacent shape grid, trajectory identity at fixed seeds, diag-columns
+preservation, tier resolution, and the fused cost-model entry.
+
+The shape grid is single-sourced from scripts/fused_report.py (the
+BENCH_TPU_fused artifact generator) so the committed artifact and the
+test suite can never check different shapes.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "scripts"))
+
+import fused_report  # noqa: E402  (scripts/fused_report.py)
+
+
+# ---------------------------------------------------------------------
+# interpret-mode parity suite (fused vs gmm_sample + pair_score + argmax)
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "case", [c for c in fused_report.SHAPE_GRID if c[0] != "tiled_100k"],
+    ids=[c[0] for c in fused_report.SHAPE_GRID if c[0] != "tiled_100k"],
+)
+def test_fused_parity_bitwise_across_shape_grid(case):
+    """Default (exact-draw) mode: the fused kernel's winners are
+    BITWISE identical to the unfused draw→score→argmax chain — k_below
+    edges, single-component mixtures, NEG_BIG padding rows, unbounded
+    and log-scale cases."""
+    rec = fused_report._parity_case(*case)
+    assert rec["winner_bitwise_match"], rec
+    assert rec["diag_max_abs_err"] < 1e-3, rec
+
+
+def test_fused_parity_100k_tiled_shape():
+    """The 100k-history bucket (k_above = 2^17 + 1): the component axis
+    walks 257 in-kernel tiles and the winner still matches bitwise."""
+    case = next(c for c in fused_report.SHAPE_GRID if c[0] == "tiled_100k")
+    rec = fused_report._parity_case(*case)
+    assert rec["winner_bitwise_match"], rec
+    assert rec["k_total"] > 2 ** 17, rec
+
+
+def test_fused_in_kernel_draw_within_documented_tolerance():
+    """The opt-in in-kernel draw (HYPEROPT_TPU_FUSED_DRAW): candidate
+    values may differ from gmm_sample's by FMA-contraction ulps — the
+    documented tolerance — but no further."""
+    case = next(c for c in fused_report.SHAPE_GRID if c[0] == "kb_edge_one_obs")
+    rec = fused_report._parity_case(*case, draw_in_kernel=True)
+    # winner VALUE within a few ulp of the reference winner (either the
+    # same candidate off by contraction rounding, or — at a score
+    # near-tie — a neighbouring candidate; neither seen at these seeds
+    # beyond ulp scale)
+    assert rec["winner_max_abs_err"] < 1e-5, rec
+    assert rec["diag_max_abs_err"] < 1e-3, rec
+
+
+def test_fused_scores_match_pallas_scorer_bitwise():
+    """The kernel's scoring stage IS pallas_gmm's online logsumexp: at
+    the same (tc, tk) the fused winner equals the batched Pallas
+    scorer's argmax bitwise (the score-path identity that makes the
+    TPU auto-promotion pallas→fused trajectory-safe)."""
+    import jax
+    import jax.numpy as jnp
+
+    from hyperopt_tpu.ops import gmm as gmm_ops
+    from hyperopt_tpu.ops.pallas_fused import fused_suggest_pallas
+    from hyperopt_tpu.ops.pallas_gmm import pair_score_pallas_batched
+    from hyperopt_tpu.ops.score import pair_params
+
+    rng = np.random.default_rng(11)
+    L, k, n_cand = 2, 2, 100
+    C = k * n_cand
+    keys = jax.random.split(jax.random.PRNGKey(11), L)
+    cands, Ps = [], []
+    for li in range(L):
+        below = fused_report._mk_mixture(rng, 6, pad=3)
+        above = fused_report._mk_mixture(rng, 40, pad=5)
+        cand = gmm_ops.gmm_sample(
+            keys[li], *below, np.float32(-2.0), np.float32(2.0),
+            np.float32(0.0), C, False,
+        )
+        cands.append(cand)
+        Ps.append(pair_params(*below, *above))
+    kb = 6 + 1 + 3
+    z = jnp.stack(cands)
+    P = jnp.stack(Ps)
+    s = np.asarray(
+        pair_score_pallas_batched(z, P, kb, tc=512, tk=512, interpret=True)
+    ).reshape(L, k, n_cand)
+    cd = np.asarray(z).reshape(L, k, n_cand)
+    idx = np.argmax(s, axis=2)
+    ref = np.take_along_axis(cd, idx[:, :, None], axis=2)[:, :, 0]
+    win = np.asarray(fused_suggest_pallas(
+        z, jnp.zeros_like(z), jnp.zeros((L, 7, kb), jnp.float32), P,
+        k_below=kb, k=k, tc=512, tk=512, interpret=True,
+    )[0])
+    assert np.array_equal(ref.astype(np.float32), win)
+
+
+def test_fused_argmax_tie_keeps_first_candidate():
+    """Duplicate candidates (equal scores) must resolve to the FIRST
+    occurrence, cross-tile — jnp.argmax semantics."""
+    import jax.numpy as jnp
+
+    from hyperopt_tpu.ops.pallas_fused import fused_suggest_pallas
+    from hyperopt_tpu.ops.score import pair_params
+
+    rng = np.random.default_rng(3)
+    K = 8
+    w = jnp.asarray(np.full(K, 1.0 / K, np.float32))
+    mu = jnp.asarray(rng.normal(0, 1, K).astype(np.float32))
+    s = jnp.asarray(np.full(K, 1.0, np.float32))
+    P = pair_params(w, mu, s, w, mu + 0.5, s)[None]
+    # 24 candidates, all identical: every score ties; winner idx must be 0
+    cand = jnp.full((1, 24), 0.25, jnp.float32)
+    win, idx, *_ = fused_suggest_pallas(
+        cand, jnp.zeros_like(cand), jnp.zeros((1, 7, K), jnp.float32), P,
+        k_below=K, k=1, tc=8, interpret=True,
+    )
+    assert int(np.asarray(idx)[0, 0]) == 0
+    assert float(np.asarray(win)[0, 0]) == 0.25
+
+
+# ---------------------------------------------------------------------
+# trajectory identity + diag preservation through the suggest plane
+# ---------------------------------------------------------------------
+
+
+def test_fused_trajectory_identical_to_unfused():
+    """fmin with HYPEROPT_TPU_SCORER=fused == default fmin, trial for
+    trial, at fixed seeds on CPU (the ISSUE-14 acceptance assertion)."""
+    rec = fused_report._trajectory_check(n_trials=30, seed=7)
+    assert rec["identical"], rec
+
+
+def test_fused_diag_columns_preserved(monkeypatch):
+    """The [L, DIAG_COLS] search-health row still rides the fused
+    readback — same shape, same column meaning, values within fp
+    tolerance of the unfused path's."""
+    from functools import partial
+
+    from hyperopt_tpu import Trials, fmin, hp
+    from hyperopt_tpu.algos import tpe, tpe_device
+    from hyperopt_tpu.base import Domain
+
+    space = {
+        "u": hp.uniform("u", -2.0, 2.0),
+        "lu": hp.loguniform("lu", -4.0, 2.0),
+        "c": hp.choice("c", [0, 1, 2]),
+    }
+    trials = Trials()
+    fmin(
+        lambda c: float(c["u"] ** 2), space,
+        algo=partial(tpe.suggest, n_EI_candidates=16), max_evals=25,
+        trials=trials, rstate=np.random.default_rng(0),
+        show_progressbar=False, verbose=False, max_speculation=0,
+    )
+    domain = Domain(lambda c: float(c["u"] ** 2), space)
+
+    def one_suggest(scorer, tid, seed):
+        if scorer is None:
+            monkeypatch.delenv("HYPEROPT_TPU_SCORER", raising=False)
+        else:
+            monkeypatch.setenv("HYPEROPT_TPU_SCORER", scorer)
+        captured = []
+        tpe_device._suggest_observers.append(captured.append)
+        try:
+            tpe.suggest([tid], domain, trials, seed, n_EI_candidates=16)
+        finally:
+            tpe_device._suggest_observers.remove(captured.append)
+        resolve = tpe_device.multi_family_suggest_async(captured[-1])
+        resolve()
+        return resolve.diag
+
+    diag_ref = one_suggest(None, 1000, 42)
+    diag_fused = one_suggest("fused", 1001, 42)
+    from hyperopt_tpu.diagnostics import DIAG_COLS
+
+    assert len(diag_fused) == len(diag_ref)
+    for df, dr in zip(diag_fused, diag_ref):
+        assert df.shape == dr.shape
+        assert df.shape[1] == DIAG_COLS
+        np.testing.assert_allclose(df, dr, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_suggest_docs_equal_default_suggest(monkeypatch):
+    """One suggest, in-process: the fused tier's trial docs equal the
+    default tier's for the same (history, seed)."""
+    from functools import partial
+
+    from hyperopt_tpu import Trials, fmin, hp
+    from hyperopt_tpu.algos import tpe
+    from hyperopt_tpu.base import Domain
+
+    space = {"u": hp.uniform("u", -2.0, 2.0), "n": hp.normal("n", 0.0, 1.0)}
+    trials = Trials()
+    fmin(
+        lambda c: float(c["u"] ** 2 + c["n"] ** 2), space,
+        algo=partial(tpe.suggest, n_EI_candidates=24), max_evals=25,
+        trials=trials, rstate=np.random.default_rng(1),
+        show_progressbar=False, verbose=False, max_speculation=0,
+    )
+    domain = Domain(lambda c: float(c["u"] ** 2 + c["n"] ** 2), space)
+    monkeypatch.delenv("HYPEROPT_TPU_SCORER", raising=False)
+    ref = tpe.suggest([900], domain, trials, 5, n_EI_candidates=24)
+    monkeypatch.setenv("HYPEROPT_TPU_SCORER", "fused")
+    fused = tpe.suggest([901], domain, trials, 5, n_EI_candidates=24)
+    for label in space:
+        assert list(ref[0]["misc"]["vals"][label]) == list(
+            fused[0]["misc"]["vals"][label]
+        ), label
+
+
+# ---------------------------------------------------------------------
+# tier resolution + cost model
+# ---------------------------------------------------------------------
+
+
+def test_effective_scorer_fused_tier(monkeypatch):
+    from hyperopt_tpu.ops.score import PALLAS_MIN_K, effective_scorer
+
+    monkeypatch.delenv("HYPEROPT_TPU_SCORER", raising=False)
+    # auto-selected fused demotes below the VMEM crossover, like pallas
+    assert effective_scorer("fused", PALLAS_MIN_K - 1) == "xla"
+    assert effective_scorer("fused", PALLAS_MIN_K) == "fused"
+    # an explicit force is honored verbatim at any size
+    monkeypatch.setenv("HYPEROPT_TPU_SCORER", "fused")
+    assert effective_scorer("fused", 8) == "fused"
+
+
+def test_resolve_fused_env_and_measured(monkeypatch):
+    from hyperopt_tpu.ops import pallas_fused
+
+    monkeypatch.delenv("HYPEROPT_TPU_FUSED", raising=False)
+    monkeypatch.setattr(pallas_fused, "_fused_measured_default", None)
+    assert pallas_fused.resolve_fused() is False  # opt-in: default off
+    pallas_fused.set_default_fused(True)
+    assert pallas_fused.resolve_fused() is True
+    monkeypatch.setenv("HYPEROPT_TPU_FUSED", "0")
+    assert pallas_fused.resolve_fused() is False  # env beats measured
+    monkeypatch.setenv("HYPEROPT_TPU_FUSED", "1")
+    monkeypatch.setattr(pallas_fused, "_fused_measured_default", False)
+    assert pallas_fused.resolve_fused() is True
+
+
+def test_resolve_fused_draw_default_off(monkeypatch):
+    from hyperopt_tpu.ops.pallas_fused import resolve_fused_draw
+
+    monkeypatch.delenv("HYPEROPT_TPU_FUSED_DRAW", raising=False)
+    assert resolve_fused_draw() is False  # bit-exact default
+    monkeypatch.setenv("HYPEROPT_TPU_FUSED_DRAW", "1")
+    assert resolve_fused_draw() is True
+
+
+def test_fused_probe_not_run_off_tpu(monkeypatch):
+    from hyperopt_tpu.algos import tpe
+
+    monkeypatch.delenv("HYPEROPT_TPU_SCORER", raising=False)
+    monkeypatch.delenv("HYPEROPT_TPU_FUSED", raising=False)
+    monkeypatch.setattr(tpe, "_probed_scorer", None)
+    monkeypatch.setattr(tpe, "_fused_probe_attempted", False)
+    called = []
+    monkeypatch.setattr(
+        tpe, "_fused_timing_probe", lambda *a, **k: called.append(1)
+    )
+    assert tpe._use_pallas() == "xla"
+    assert not called
+
+
+def test_pair_score_cost_fused_entry(monkeypatch):
+    """The fused cost entry encodes ZERO [C, K] HBM round-trips: its
+    traffic is O(C + K) while the XLA entry grows O(C*K), and it drops
+    the candidate round trip the plain pallas entry still pays."""
+    monkeypatch.setenv("HYPEROPT_TPU_SCORER", "1")  # forces verbatim tiers
+    from hyperopt_tpu.ops.score import pair_score_cost
+
+    C, K = 8192, 131_105
+    fused = pair_score_cost(C, K, "fused")
+    pallas = pair_score_cost(C, K, "pallas")
+    xla = pair_score_cost(C, K, "xla")
+    # no comp matrix: orders of magnitude below the XLA traffic model
+    assert fused["bytes"] < xla["bytes"] / 100
+    # no candidate/score round trip either: strictly below pallas
+    assert fused["bytes"] < pallas["bytes"]
+    # O(C + K) scaling: doubling C adds ~8 bytes/candidate, not O(K)
+    fused2 = pair_score_cost(2 * C, K, "fused")
+    assert fused2["bytes"] - fused["bytes"] == pytest.approx(4.0 * 2 * C)
+    # the matmul subset (MFU's denominator) is scorer-independent
+    assert fused["mxu_flops"] == xla["mxu_flops"]
+    # the draw/select stages are charged O(C)
+    assert fused["flops"] > pallas["flops"]
+
+
+def test_cont_request_cost_fused_drops_candidate_roundtrip(monkeypatch):
+    """profiling's per-family model must not double-charge the fused
+    kernel for the candidate round trip (DeviceStats roofline truth)."""
+    monkeypatch.setenv("HYPEROPT_TPU_SCORER", "1")
+    import jax.numpy as jnp
+
+    from hyperopt_tpu.profiling import _cont_request_cost
+
+    L, cap, capt = 2, 1024, 2048
+    args = [None, jnp.zeros((L, cap)), None, None, jnp.zeros(capt)]
+    st = dict(cap_b=32, k=1, n_cand=8192, quantized=False, n_buckets=0)
+    fused = _cont_request_cost(args, dict(st, scorer="fused"))
+    pallas = _cont_request_cost(args, dict(st, scorer="pallas"))
+    xla = _cont_request_cost(args, dict(st, scorer="xla"))
+    C = 8192
+    # the pallas arm charges the 2*L*C*4 candidate round trip on top of
+    # its pair_score_cost; the fused arm must not
+    assert pallas["bytes"] - fused["bytes"] > 2.0 * L * C * 4.0 * 0.9
+    assert fused["bytes"] < xla["bytes"]
+
+
+def test_fused_statics_key_only_on_fused_programs(monkeypatch):
+    """Only fused programs carry the fused_draw static — every other
+    tier's signature (and the compile ledger's recorded grid) is
+    unchanged by this PR."""
+    from functools import partial
+
+    from hyperopt_tpu import Trials, fmin, hp
+    from hyperopt_tpu.algos import tpe, tpe_device
+
+    space = {"u": hp.uniform("u", -2.0, 2.0)}
+
+    def capture(scorer):
+        if scorer is None:
+            monkeypatch.delenv("HYPEROPT_TPU_SCORER", raising=False)
+        else:
+            monkeypatch.setenv("HYPEROPT_TPU_SCORER", scorer)
+        captured = []
+        tpe_device._suggest_observers.append(captured.append)
+        try:
+            fmin(
+                lambda c: float(c["u"] ** 2), space,
+                algo=partial(tpe.suggest, n_EI_candidates=8), max_evals=24,
+                trials=Trials(), rstate=np.random.default_rng(0),
+                show_progressbar=False, verbose=False, max_speculation=0,
+            )
+        finally:
+            tpe_device._suggest_observers.remove(captured.append)
+        return captured[-1]
+
+    default_req = capture(None)
+    fused_req = capture("fused")
+    default_st = default_req[0][2]
+    fused_st = fused_req[0][2]
+    assert "fused_draw" not in default_st
+    assert fused_st["fused_draw"] is False
+    assert fused_st["scorer"] == "fused"
+
+
+def test_fused_winners_under_mesh_bitwise_equal_meshless():
+    """The PL209 pin contract at RUNTIME: with every pallas_call
+    operand pinned replicated, the fused kernel under the virtual
+    8-device mesh produces bitwise the meshless winners."""
+    import jax
+    import jax.numpy as jnp
+
+    from hyperopt_tpu.algos.tpe_device import _fused_winners
+    from hyperopt_tpu.analysis.program_lint import virtual_mesh
+    from hyperopt_tpu.ops.score import pair_params
+
+    mesh = virtual_mesh()
+    if mesh is None:
+        pytest.skip("needs >=2 devices (XLA_FLAGS device-count force)")
+    rng = np.random.default_rng(0)
+    L, kb, ka, k, n_cand = 2, 8, 40, 2, 64
+
+    def mk(n):
+        w = rng.uniform(0.1, 1.0, n).astype(np.float32)
+        w /= w.sum()
+        return (
+            jnp.asarray(w),
+            jnp.asarray(rng.normal(0, 2, n).astype(np.float32)),
+            jnp.asarray(rng.uniform(0.3, 2, n).astype(np.float32)),
+        )
+
+    Ps, cands = [], []
+    for _ in range(L):
+        Ps.append(pair_params(*mk(kb), *mk(ka)))
+        cands.append(
+            jnp.asarray(rng.normal(0, 1, (k * n_cand,)).astype(np.float32))
+        )
+    P = jnp.stack(Ps)
+    cand = jnp.stack(cands)
+
+    def run(m):
+        @jax.jit
+        def prog(cand, P):
+            win, _ei = _fused_winners(
+                m, cand, P, kb, k=k, n_cand=n_cand, log_scale=False,
+                fused_draw=False,
+            )
+            return win
+
+        return np.asarray(prog(cand, P))
+
+    assert np.array_equal(run(None), run(mesh))
+
+
+# ---------------------------------------------------------------------
+# ei_from_partials unit
+# ---------------------------------------------------------------------
+
+
+def test_ei_from_partials_matches_dense_reduction():
+    import jax.numpy as jnp
+
+    from hyperopt_tpu.algos.tpe_device import _ei_diag
+    from hyperopt_tpu.ops.pallas_fused import ei_from_partials
+
+    rng = np.random.default_rng(0)
+    L, k, n_cand, n_top = 3, 4, 37, 16
+    scores = rng.normal(0, 3, (L, k, n_cand)).astype(np.float32)
+    # per-segment partials computed densely (what the kernel accumulates)
+    m = scores.max(axis=2)
+    s = np.exp(scores - m[:, :, None]).sum(axis=2)
+    top = -np.sort(-scores, axis=2)[:, :, :n_top]
+    g_max, g_lme, g_mass = (
+        np.asarray(v)
+        for v in ei_from_partials(
+            jnp.asarray(m), jnp.asarray(s), jnp.asarray(top),
+            k * n_cand, n_top,
+        )
+    )
+    r_max, r_lme, r_mass = (
+        np.asarray(v)
+        for v in _ei_diag(jnp.asarray(scores.reshape(L, k * n_cand)))
+    )
+    np.testing.assert_allclose(g_max, r_max, rtol=1e-6)
+    np.testing.assert_allclose(g_lme, r_lme, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(g_mass, r_mass, rtol=1e-5, atol=1e-6)
