@@ -1078,7 +1078,48 @@ def failover_section(argv):
     return 0 if report["ok"] else 1
 
 
+def store_section(argv):
+    """``python bench.py --store [--quick]``: storage-plane A/B — the
+    per-doc layout vs the segmented append-only trial log
+    (scripts/store_bench.py) at 10k and 100k trials (one small scale
+    with ``--quick``).  Gates: >=10x fewer fsyncs per state transition
+    (the B=64 group commit), zero O(N) scans on the segmented path,
+    warm refresh replaying exactly the appended delta, cold-open
+    recovery replaying the full log, lossless compaction.  Ratios and
+    counts only — never absolute milliseconds.  A quick run writes a
+    separate file so CI can never clobber the committed full artifact
+    (the PR 7 convention).  Prints ONE JSON line like the other bench
+    sections."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    store_bench = _import_script("store_bench")
+    quick = "--quick" in argv
+    out_path = "BENCH_STORE.quick.json" if quick else "BENCH_STORE.json"
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    t0 = time.time()
+    report = store_bench.run_campaign(quick=quick)
+    store_bench.write_report(report, out_path)
+    ratios = report["headline"]["fsync_ratio_doc_over_segment"]
+    out = {
+        "metric": "store_bench",
+        "value": min(ratios.values()) if ratios else None,
+        "unit": "x_fewer_fsyncs_per_transition",
+        "ok": report["ok"],
+        "fsync_ratio_doc_over_segment": ratios,
+        "scales": report["scales"],
+        "batch": report["batch"],
+        "errors": report["errors"],
+        "artifact": out_path,
+        "elapsed_s": round(time.time() - t0, 2),
+    }
+    print(json.dumps(out))
+    return 0 if report["ok"] else 1
+
+
 def main():
+    if "--store" in sys.argv[1:]:
+        argv = [a for a in sys.argv[1:] if a != "--store"]
+        return store_section(argv)
     if "--slo" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--slo"]
         return slo_section(argv)

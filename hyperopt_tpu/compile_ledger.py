@@ -49,7 +49,7 @@ import os
 import threading
 import time
 
-from . import tracing
+from . import journal_io, tracing
 
 logger = logging.getLogger(__name__)
 
@@ -389,33 +389,24 @@ class CompileLedger:
             self._by_key[key] = rec
             if self.path:
                 # one crash-atomic O_APPEND write + fsync — a torn
-                # tail garbles at most this record, resync'd on load
-                line = tracing.format_record(rec)
-                fd = os.open(
-                    self.path,
-                    os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644,
+                # tail garbles at most this record, resync'd on load.
+                # The ledger lock deliberately serializes journal I/O:
+                # appends must land in seq order and must not
+                # interleave with the compaction rewrite below.
+                journal_io.append_record(  # lint: disable=RL305
+                    self.path, rec, fsync_kind="ledger"
                 )
-                try:
-                    os.write(fd, line)
-                    # the ledger lock deliberately serializes journal
-                    # I/O: appends must land in seq order and must not
-                    # interleave with the compaction rewrite below
-                    os.fsync(fd)  # lint: disable=RL305
-                finally:
-                    os.close(fd)
                 self._appends_since_compact += 1
                 if self._appends_since_compact > (
                     COMPACT_APPEND_FACTOR * max(len(self._order), 1)
                 ):
                     # compaction: rewrite with only the live (latest-
                     # per-key) entries — atomic replace, crash-safe
-                    from .parallel.file_trials import _atomic_write
-
-                    blob = b"".join(
-                        tracing.format_record(self._by_key[k])
-                        for k in self._order
+                    journal_io.compact_records(
+                        self.path,
+                        [self._by_key[k] for k in self._order],
+                        fsync_kind="ledger",
                     )
-                    _atomic_write(self.path, blob, fsync_kind="journal")
                     self._appends_since_compact = 0
         return rec
 

@@ -1052,8 +1052,13 @@ class StoreStats:
     Every durability-relevant filesystem operation lands here:
 
     - **fsyncs** — count + fixed-bucket latency histogram + bytes, by
-      ``kind`` (``doc``/``journal``/``attachment``/``counter``/
-      ``lease``/``bundle``) — the SL606 objective's input;
+      ``kind`` (``doc``/``segment``/``journal``/``attachment``/
+      ``counter``/``lease``/``bundle``) — the SL606 objective's input;
+    - **segments** — appends (write calls vs records: the group-commit
+      ratio), seals, compactions, O(delta) replays + their record
+      counts, torn records, and replica pulls of the segmented trial
+      store (the committed before/after proof for the per-doc →
+      segment migration);
     - **doc writes** — trial-doc inserts/rewrites and their encoded
       bytes (reconciles against trial counts: one insert + one result
       write per completed trial on the service path);
@@ -1098,6 +1103,19 @@ class StoreStats:
         self._journal_torn = 0  # guarded-by: _lock
         self._lease_events = defaultdict(int)  # guarded-by: _lock
         self._quarantined = 0  # guarded-by: _lock
+        # segmented trial store (parallel.segment_store)
+        self._segment_appends = 0  # guarded-by: _lock  (write calls)
+        self._segment_records = 0  # guarded-by: _lock  (docs appended)
+        self._segment_bytes = 0  # guarded-by: _lock
+        self._segment_seals = 0  # guarded-by: _lock
+        self._segment_compactions = 0  # guarded-by: _lock
+        self._segments_retired = 0  # guarded-by: _lock
+        self._segment_replays = 0  # guarded-by: _lock  (refresh calls)
+        self._segment_replays_full = 0  # guarded-by: _lock
+        self._segment_replay_records = 0  # guarded-by: _lock  (delta docs)
+        self._segment_torn = 0  # guarded-by: _lock
+        self._segments_pulled = 0  # guarded-by: _lock  (replication)
+        self._segment_pull_bytes = 0  # guarded-by: _lock
         self._recent_ops = deque(maxlen=self.MAX_RECENT_OPS)  # guarded-by: _lock
 
     # -- recording -----------------------------------------------------
@@ -1148,6 +1166,43 @@ class StoreStats:
         with self._lock:
             self._journal_torn += int(n)
 
+    def record_segment_append(self, n_records: int, nbytes: int):
+        """One segment write call (group commit): ``n_records``
+        trial-state transitions landed in ONE O_APPEND write."""
+        with self._lock:
+            self._segment_appends += 1
+            self._segment_records += int(n_records)
+            self._segment_bytes += int(nbytes)
+
+    def record_segment_seal(self, n: int = 1):
+        with self._lock:
+            self._segment_seals += int(n)
+
+    def record_segment_compaction(self, n_retired: int = 0):
+        with self._lock:
+            self._segment_compactions += 1
+            self._segments_retired += int(n_retired)
+
+    def record_segment_replay(self, n_records: int, full: bool = False):
+        """One O(delta) tail refresh replaying ``n_records`` docs
+        (``full``: a from-scratch replay — initial load or a
+        post-compaction epoch change)."""
+        with self._lock:
+            self._segment_replays += 1
+            if full:
+                self._segment_replays_full += 1
+            self._segment_replay_records += int(n_records)
+
+    def record_segment_torn(self, n: int = 1):
+        with self._lock:
+            self._segment_torn += int(n)
+
+    def record_segment_pull(self, n_segments: int, nbytes: int):
+        """Sealed segments shipped to a replica by SegmentMirror."""
+        with self._lock:
+            self._segments_pulled += int(n_segments)
+            self._segment_pull_bytes += int(nbytes)
+
     def record_lease(self, event: str, n: int = 1):
         """``event``: grant | renew | reap | clear | quarantine."""
         with self._lock:
@@ -1172,7 +1227,10 @@ class StoreStats:
         journal lines + quarantined docs)."""
         with self._lock:
             return {
-                "store_bad": self._journal_torn + self._quarantined,
+                "store_bad": (
+                    self._journal_torn + self._quarantined
+                    + self._segment_torn
+                ),
                 "fsyncs_total": sum(self._fsync_kinds.values()),
             }
 
@@ -1214,6 +1272,18 @@ class StoreStats:
                 "journal_bytes": self._journal_bytes,
                 "journal_compactions": self._journal_compactions,
                 "journal_torn_lines": self._journal_torn,
+                "segment_appends": self._segment_appends,
+                "segment_records": self._segment_records,
+                "segment_bytes": self._segment_bytes,
+                "segment_seals": self._segment_seals,
+                "segment_compactions": self._segment_compactions,
+                "segments_retired": self._segments_retired,
+                "segment_replays": self._segment_replays,
+                "segment_replays_full": self._segment_replays_full,
+                "segment_replay_records": self._segment_replay_records,
+                "segment_torn_lines": self._segment_torn,
+                "segments_pulled": self._segments_pulled,
+                "segment_pull_bytes": self._segment_pull_bytes,
                 "lease_events": dict(sorted(self._lease_events.items())),
                 "quarantined_docs": self._quarantined,
             }
@@ -1581,8 +1651,8 @@ def render_prometheus(
     if store is not None:
         s = store.summary()
         head("store_fsyncs_total",
-             "Storage-plane fsyncs by kind (doc/journal/attachment/"
-             "counter/lease/bundle).", "counter")
+             "Storage-plane fsyncs by kind (doc/segment/journal/"
+             "attachment/counter/lease/bundle).", "counter")
         for kind, n in s["fsyncs"].items():
             sample("store_fsyncs_total", {"kind": kind}, n)
         histogram("store_fsync_duration_seconds",
@@ -1634,6 +1704,54 @@ def render_prometheus(
              "counter")
         sample("store_journal_torn_lines_total", None,
                s["journal_torn_lines"])
+        head("store_segment_appends_total",
+             "Segment-log write calls (each ONE O_APPEND write + one "
+             "fsync; a batch of docs group-commits as one).", "counter")
+        sample("store_segment_appends_total", None, s["segment_appends"])
+        head("store_segment_records_total",
+             "Trial-state transitions appended to the segment log.",
+             "counter")
+        sample("store_segment_records_total", None, s["segment_records"])
+        head("store_segment_bytes_total",
+             "Bytes appended to the segment log.", "counter")
+        sample("store_segment_bytes_total", None, s["segment_bytes"])
+        head("store_segment_seals_total",
+             "Segments sealed (made immutable and manifest-pinned).",
+             "counter")
+        sample("store_segment_seals_total", None, s["segment_seals"])
+        head("store_segment_compactions_total",
+             "Segment-log compactions (latest-doc-per-tid folds).",
+             "counter")
+        sample("store_segment_compactions_total", None,
+               s["segment_compactions"])
+        head("store_segments_retired_total",
+             "Segments retired (unlinked) by compaction.", "counter")
+        sample("store_segments_retired_total", None,
+               s["segments_retired"])
+        head("store_segment_replays_total",
+             "O(delta) segment-tail refreshes, by scope.", "counter")
+        sample("store_segment_replays_total", {"scope": "delta"},
+               s["segment_replays"] - s["segment_replays_full"])
+        sample("store_segment_replays_total", {"scope": "full"},
+               s["segment_replays_full"])
+        head("store_segment_replay_records_total",
+             "Docs replayed by segment-tail refreshes (the delta cost "
+             "that replaces O(N) directory scans).", "counter")
+        sample("store_segment_replay_records_total", None,
+               s["segment_replay_records"])
+        head("store_segment_torn_lines_total",
+             "Torn segment records seen at replay (SL605 input).",
+             "counter")
+        sample("store_segment_torn_lines_total", None,
+               s["segment_torn_lines"])
+        head("store_segments_pulled_total",
+             "Sealed segments pulled by replica mirrors.", "counter")
+        sample("store_segments_pulled_total", None, s["segments_pulled"])
+        head("store_segment_pull_bytes_total",
+             "Bytes shipped to replica mirrors as sealed segments.",
+             "counter")
+        sample("store_segment_pull_bytes_total", None,
+               s["segment_pull_bytes"])
         head("store_lease_events_total",
              "Lease protocol events (grant/renew/reap/clear).", "counter")
         for event, n in s["lease_events"].items():

@@ -221,14 +221,59 @@ def _read_doc(path, quarantine=True):
     return None
 
 
-class FileJobs:
-    """Low-level queue operations (the MongoJobs analog)."""
+def default_backend(root) -> str:
+    """Which trial-store backend a queue directory carries.
 
-    def __init__(self, root, lease_ttl=DEFAULT_LEASE_TTL):
+    - a ``segments/MANIFEST.json`` marker → ``"segment"``;
+    - legacy per-doc layout (``trials/*.json`` present, no manifest) →
+      ``"doc"`` — old queues keep working untouched;
+    - a fresh directory → the ``HYPEROPT_TPU_STORE_BACKEND`` env var if
+      set, else ``"segment"`` (the default backend: the per-doc layout
+      does one fsync'd atomic replace per transition and an O(N)
+      directory scan per refresh; the segmented log group-commits and
+      replays O(delta) tails — see ``parallel.segment_store``).
+    """
+    from . import segment_store
+
+    root = os.path.abspath(root)
+    if segment_store.SegmentStore.is_segmented(root):
+        return "segment"
+    if glob.glob(os.path.join(root, "trials", "*.json")):
+        return "doc"
+    return os.environ.get("HYPEROPT_TPU_STORE_BACKEND", "segment")
+
+
+class FileJobs:
+    """Low-level queue operations (the MongoJobs analog).
+
+    Two interchangeable trial-doc backends behind one API:
+
+    - ``"segment"`` (default for new queues): the append-only segment
+      log of :mod:`hyperopt_tpu.parallel.segment_store` — one
+      CRC-framed ``O_APPEND`` group commit per write call, an in-memory
+      materialized view served to ``all_docs``/``count_states``/
+      ``reserve``, refresh = O(delta) tail replay, ZERO O(N) directory
+      scans;
+    - ``"doc"`` (legacy, auto-detected): one ``trials/<tid>.json`` per
+      trial, atomic replace per write, directory scans on read.
+
+    Locks, leases, attachments, and the id counter are backend-
+    independent — the reservation protocol is untouched.
+    """
+
+    def __init__(self, root, lease_ttl=DEFAULT_LEASE_TTL, backend=None):
         self.root = os.path.abspath(root)
         self.lease_ttl = float(lease_ttl)
         for sub in ("trials", "locks", "leases", "attachments"):
             os.makedirs(os.path.join(self.root, sub), exist_ok=True)
+        self.backend = backend or default_backend(self.root)
+        if self.backend not in ("segment", "doc"):
+            raise ValueError(f"unknown trial-store backend {self.backend!r}")
+        self.segments = None
+        if self.backend == "segment":
+            from .segment_store import SegmentStore
+
+            self.segments = SegmentStore(self.root)
         # Process-local gate in FRONT of the cross-process counter file
         # lock: threads of one process queue on a cheap mutex instead of
         # contending on the O_CREAT|O_EXCL spin loop (10 ms sleeps).
@@ -308,6 +353,13 @@ class FileJobs:
         # tracing.span is a no-op singleton unless the calling thread
         # has a request trace bound (the optimization service's store
         # writes do; driver/worker writes normally don't)
+        if self.segments is not None:
+            with tracing.span("store.segment_append", tid=int(doc["tid"])):
+                self.segments.append(doc)
+            chaos = _active_chaos()
+            if chaos is not None:
+                chaos.maybe_torn_lock(self, doc["tid"])
+            return
         with tracing.span("store.write_doc", tid=int(doc["tid"])):
             nbytes = _write_doc(self.trial_path(doc["tid"]), doc)
         stats = _store_stats
@@ -318,7 +370,27 @@ class FileJobs:
             chaos.maybe_torn_lock(self, doc["tid"])
             chaos.maybe_torn_doc(self.trial_path(doc["tid"]), doc["tid"])
 
+    def insert_many(self, docs):
+        """Insert a batch — ONE group-committed segment append (one
+        O_APPEND write + one fsync for the whole batch) on the
+        segmented backend; a per-doc loop on the legacy one."""
+        if not docs:
+            return
+        if self.segments is not None:
+            with tracing.span("store.segment_append", n_docs=len(docs)):
+                self.segments.append_many(docs)
+            chaos = _active_chaos()
+            if chaos is not None:
+                chaos.maybe_torn_lock(self, docs[0]["tid"])
+            return
+        for doc in docs:
+            self.insert(doc)
+
     def write(self, doc):
+        if self.segments is not None:
+            with tracing.span("store.segment_append", tid=int(doc["tid"])):
+                self.segments.append(doc)
+            return
         with tracing.span("store.write_doc", tid=int(doc["tid"])):
             nbytes = _write_doc(self.trial_path(doc["tid"]), doc)
         stats = _store_stats
@@ -330,9 +402,15 @@ class FileJobs:
 
     def read_doc(self, tid):
         """One trial doc by id (None if absent/unreadable)."""
+        if self.segments is not None:
+            return self.segments.get(tid)
         return _read_doc(self.trial_path(tid))
 
     def all_docs(self):
+        if self.segments is not None:
+            # the materialized view: an O(delta) tail replay then an
+            # in-memory read — ZERO directory scans on this path
+            return self.segments.all_docs()
         docs = []
         paths = sorted(glob.glob(os.path.join(self.root, "trials", "*.json")))
         stats = _store_stats
@@ -364,7 +442,7 @@ class FileJobs:
         pid/nanosecond digits, not ``.json``) but they accumulate
         forever without a GC."""
         out = []
-        for sub in ("trials", "locks", "leases", "attachments"):
+        for sub in ("trials", "locks", "leases", "attachments", "segments"):
             out.extend(
                 glob.glob(os.path.join(self.root, sub, "*.tmp.*"))
             )
@@ -460,7 +538,12 @@ class FileJobs:
 
         Uses the native scanner (``native/fastqueue.cpp``) when built; a
         parse mismatch or missing toolchain falls back to exact parsing.
+        On the segmented backend the materialized view answers in O(1)
+        after an O(delta) tail refresh — no directory scan at all.
         """
+        if self.segments is not None:
+            counts = self.segments.count_states()
+            return {s: counts.get(s, 0) for s in JOB_STATES}
         res = _native.count_states(os.path.join(self.root, "trials"))
         if res is not None:
             counts, _ = res
@@ -476,6 +559,8 @@ class FileJobs:
         return counts
 
     def _new_tids(self):
+        if self.segments is not None:
+            return self.segments.tids_in_state(JOB_STATE_NEW)
         tids = _native.list_state(
             os.path.join(self.root, "trials"), JOB_STATE_NEW
         )
@@ -492,6 +577,8 @@ class FileJobs:
         """Trial ids currently in JOB_STATE_RUNNING — the lease reaper's
         scan primitive (native fast path; the reaper polls every few
         seconds and must not re-parse the whole queue each time)."""
+        if self.segments is not None:
+            return self.segments.tids_in_state(JOB_STATE_RUNNING)
         tids = _native.list_state(
             os.path.join(self.root, "trials"), JOB_STATE_RUNNING
         )
@@ -580,7 +667,7 @@ class FileJobs:
         for tid in self._new_tids():
             if not self._try_lock(self.lock_path(tid), owner):
                 continue  # someone else owns it
-            doc = _read_doc(self.trial_path(tid))  # re-read under the lock
+            doc = self.read_doc(tid)  # re-read under the lock
             if doc is None or doc["state"] != JOB_STATE_NEW:
                 # Lost a race (e.g. grabbed the lock inside requeue_stale's
                 # unlink->rewrite window while the doc still reads RUNNING).
@@ -690,8 +777,10 @@ class FileTrials(Trials):
     poll_interval_secs = 0.25
 
     def __init__(self, queue_dir, exp_key=None, refresh=True,
-                 lease_ttl=DEFAULT_LEASE_TTL):
-        self.jobs = FileJobs(queue_dir, lease_ttl=lease_ttl)
+                 lease_ttl=DEFAULT_LEASE_TTL, backend=None):
+        self.jobs = FileJobs(queue_dir, lease_ttl=lease_ttl, backend=backend)
+        self._seg_cursor = None  # SegmentStore.docs_since consumer cursor
+        self._tid_pos = None     # tid -> index into _dynamic_trials
         super().__init__(exp_key=exp_key, refresh=False)
         self.attachments = _FileAttachments(self.jobs)
         if refresh:
@@ -701,8 +790,29 @@ class FileTrials(Trials):
         stats = _store_stats
         if stats is not None:
             stats.record_refresh(local=False)
-        self._dynamic_trials = self.jobs.all_docs()
+        segs = self.jobs.segments
+        if segs is None:
+            self._dynamic_trials = self.jobs.all_docs()
+        else:
+            # O(delta) refresh: only docs appended (anywhere — this
+            # process or another) since our cursor, NOT an O(N) rebuild
+            self._seg_cursor, delta = segs.docs_since(self._seg_cursor)
+            if self._tid_pos is None:
+                self._tid_pos = {
+                    d["tid"]: i for i, d in enumerate(self._dynamic_trials)
+                }
+            for doc in delta:
+                self._apply_dynamic_doc(doc)
         super().refresh()
+
+    def _apply_dynamic_doc(self, doc):
+        """Fold one delta doc into ``_dynamic_trials`` latest-wins."""
+        pos = self._tid_pos.get(doc["tid"])
+        if pos is None:
+            self._tid_pos[doc["tid"]] = len(self._dynamic_trials)
+            self._dynamic_trials.append(doc)
+        else:
+            self._dynamic_trials[pos] = doc
 
     def refresh_local(self):
         """Recompute the derived views (``_trials``, the SoA history)
@@ -721,12 +831,16 @@ class FileTrials(Trials):
         super().refresh()
 
     def _insert_trial_docs(self, docs):
-        rval = []
-        for doc in docs:
-            self.jobs.insert(doc)
-            rval.append(doc["tid"])
-        self._dynamic_trials.extend(docs)
-        return rval
+        docs = list(docs)
+        # ONE group-committed segment append for the whole batch (the
+        # legacy backend falls back to a per-doc loop inside insert_many)
+        self.jobs.insert_many(docs)
+        if self._tid_pos is not None:
+            for doc in docs:
+                self._apply_dynamic_doc(doc)
+        else:
+            self._dynamic_trials.extend(docs)
+        return [doc["tid"] for doc in docs]
 
     def new_trial_ids(self, n):
         ids = self.jobs.new_trial_ids(n)
@@ -734,6 +848,10 @@ class FileTrials(Trials):
         return ids
 
     def delete_all(self):
+        if self.jobs.segments is not None:
+            self.jobs.segments.delete_all()
+        self._seg_cursor = None
+        self._tid_pos = None
         for p in glob.glob(os.path.join(self.jobs.root, "trials", "*.json")):
             os.unlink(p)
         for p in glob.glob(

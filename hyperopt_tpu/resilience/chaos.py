@@ -106,7 +106,7 @@ from collections import defaultdict
 from dataclasses import dataclass
 
 from ..observability import FaultStats
-from ..tracing import current_trace_id, format_record
+from ..tracing import current_trace_id
 from .device import SyntheticDeviceError
 
 logger = logging.getLogger(__name__)
@@ -148,6 +148,9 @@ class ChaosConfig:
     p_torn_doc: float = 0.0
     p_torn_journal: float = 0.0
     p_slow_loris: float = 0.0
+    # segmented-store sites (segment log campaign)
+    p_torn_segment: float = 0.0     # clip the tail off a segment append
+    p_compaction_kill: float = 0.0  # SIGKILL inside the compaction window
     # replica-plane sites (failover campaign, ISSUE 13)
     p_replica_kill: float = 0.0     # supervisor SIGKILLs the owning replica
     p_lease_stall: float = 0.0      # heartbeat frozen past the lease TTL
@@ -316,16 +319,14 @@ class ChaosMonkey:
         self._recent.append(record)
         if not self.config.injection_log:
             return
-        line = format_record(record)
+        from .. import journal_io
+
         try:
-            fd = os.open(
-                self.config.injection_log,
-                os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644,
+            # advisory log: no fsync — losing the final record at a
+            # crash is exactly the torn tail the frame detects
+            journal_io.append_record(
+                self.config.injection_log, record, fsync=False
             )
-            try:
-                os.write(fd, line)
-            finally:
-                os.close(fd)
         except OSError:
             logger.warning("could not append injection log", exc_info=True)
 
@@ -450,6 +451,36 @@ class ChaosMonkey:
             logger.info("chaos: tore journal tail at %s", path)
             if self.config.tear_kills_process:
                 self._die_mid_write()
+
+    def maybe_torn_segment(self, path, key):
+        """Clip the tail off a just-appended segment — the torn group
+        commit.  The incremental chunk parser leaves the invalid tail
+        unconsumed on an active segment and counts it torn once sealed;
+        with ``tear_kills_process`` (default) the process dies
+        mid-append, so the lost batch was never acknowledged."""
+        if not self._roll(
+            "torn_segment", int(key), self.config.p_torn_segment
+        ):
+            return
+        if self._tear_file(path, drop_bytes=11):
+            logger.info("chaos: tore segment tail at %s", path)
+            if self.config.tear_kills_process:
+                self._die_mid_write()
+
+    def maybe_compaction_kill(self, segments_dir, epoch):
+        """SIGKILL inside compaction's vulnerable window: the new
+        manifest (epoch N+1) is published but the retired epoch-N
+        segments are not yet unlinked — recovery must replay the folded
+        base and fsck FS412 must sweep the orphans."""
+        if not self._roll(
+            "compaction_kill", int(epoch), self.config.p_compaction_kill
+        ):
+            return
+        logger.info(
+            "chaos: killing mid-compaction (epoch %s) in %s",
+            epoch, segments_dir,
+        )
+        self._die_mid_write()
 
     def should_reset_connection(self, route: str, key, when: str) -> bool:
         """Roll a connection-reset site.  ``when`` is ``"pre"`` (drop

@@ -40,6 +40,24 @@ FS408     broken id allocator: a stuck ``ids.counter.lock`` (allocator
           on disk (the next allocation would re-issue an existing tid).
           Repair: delete the stuck lock / advance the counter past the
           highest tid.
+FS410     torn segment record(s) in the segmented trial store (a line
+          failing its per-record CRC inside a sealed segment's byte
+          range, or — offline, where no appender can be in flight — a
+          torn final append on the active segment).  Repair: rewrite
+          the segment keeping only valid records (and update the
+          manifest entry for a sealed one).
+FS411     manifest/segment mismatch: the ``segments/MANIFEST.json`` is
+          missing or corrupt while segment files exist (repair: rebuild
+          it from the files), a sealed entry references a segment file
+          that is gone (repair: drop the entry), a sealed entry's byte
+          length exceeds the file (repair: re-pin to the valid prefix),
+          or a sealed range's CRC no longer matches (repair: recompute).
+FS412     orphaned segment file: a ``seg-*.log`` referenced by neither
+          the manifest's sealed list nor its active pointer — retired
+          segments a compactor SIGKILL'd mid-retirement failed to
+          unlink.  Repair: delete (their live records were folded into
+          the compacted base; unacknowledged stragglers share torn-
+          write semantics).
 FS409     replica-plane damage under ``<root>/replicas/``: an orphaned
           study-ownership lease (no study directory AND not live — a
           live one is the mid-create window, not damage), an expired
@@ -211,6 +229,279 @@ def _load_journal(qdir):
     return entries, torn, path
 
 
+def _rebuild_manifest(sdir, seg_paths, parse, object_hook):
+    """A best-effort manifest from the segment files alone: every
+    segment but the last (by sequence) sealed at its valid prefix, the
+    last one active.  Epoch 1 so any cached reader does a full replay."""
+    import zlib as _zlib
+
+    from ..parallel import segment_store as sstore
+
+    names = sorted(os.path.basename(p) for p in seg_paths)
+    sealed = []
+    for name in names[:-1]:
+        try:
+            with open(os.path.join(sdir, name), "rb") as f:
+                raw = f.read()
+        except OSError:
+            continue
+        records, consumed, _, _ = parse(raw, object_hook=object_hook)
+        sealed.append({
+            "name": name,
+            "bytes": consumed,
+            "records": len(records),
+            "crc32": "%08x" % (_zlib.crc32(raw[:consumed]) & 0xFFFFFFFF),
+        })
+    active = names[-1] if names else sstore.segment_name(1)
+    try:
+        next_seq = int(active[4:12]) + 1
+    except ValueError:
+        next_seq = len(names) + 1
+    return {
+        "version": 1,
+        "epoch": 1,
+        "next_seq": next_seq,
+        "active": active,
+        "sealed": sealed,
+    }
+
+
+def _fsck_segments(qdir, repair, report: FsckReport) -> dict:
+    """FS410/FS411/FS412 over ``<qdir>/segments``; returns the replayed
+    {tid: doc} view so the lease/lock/cursor/counter rules see segment-
+    stored trials exactly like per-doc ones.  Empty dict when the queue
+    is not segmented."""
+    import zlib as _zlib
+
+    from ..parallel import segment_store as sstore
+    from ..parallel.file_trials import (
+        _atomic_write,
+        _json_object_hook,
+        _read_doc,
+        _write_doc,
+    )
+
+    sdir = os.path.join(qdir, "segments")
+    manifest_path = os.path.join(sdir, sstore.MANIFEST_NAME)
+    seg_paths = sorted(glob.glob(os.path.join(sdir, sstore.SEGMENT_GLOB)))
+    have_manifest = os.path.exists(manifest_path)
+    if not (have_manifest or seg_paths):
+        return {}
+    parse = sstore.parse_segment_chunk
+
+    manifest = (
+        _read_doc(manifest_path, quarantine=False) if have_manifest else None
+    )
+    if manifest is None:
+        # FS411: segment files with no (readable) manifest — recovery
+        # cannot know the replay order or sealed byte ranges
+        rebuilt = _rebuild_manifest(sdir, seg_paths, parse, _json_object_hook)
+        fixed = False
+        action = ""
+        if repair:
+            try:
+                if have_manifest:
+                    dest = quarantine_path(manifest_path)
+                    os.replace(manifest_path, dest)
+                    action = f"quarantined to {os.path.basename(dest)}; "
+                # durability: exempt(offline repair: fsck runs single-writer against a stopped queue)
+                _write_doc(manifest_path, rebuilt, fsync_kind="segment")
+                fixed = True
+                action += (
+                    f"rebuilt manifest from {len(seg_paths)} segment "
+                    f"file(s)"
+                )
+            except OSError:
+                pass
+        report.add(
+            "FS411", manifest_path,
+            "corrupt segment manifest" if have_manifest
+            else "segment files without a manifest",
+            repaired=fixed, action=action,
+        )
+        manifest = rebuilt  # replay from the in-memory rebuild either way
+
+    view = {}
+    sealed_out = []
+    manifest_dirty = False
+    for entry in manifest.get("sealed", ()):
+        name = entry.get("name", "")
+        path = os.path.join(sdir, name)
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            # FS411: the manifest promises a sealed segment that is gone
+            manifest_dirty = manifest_dirty or repair
+            report.add(
+                "FS411", path,
+                f"manifest references missing sealed segment {name!r}",
+                repaired=repair,
+                action="dropped manifest entry" if repair else "",
+            )
+            continue
+        limit = int(entry.get("bytes", 0))
+        short = len(raw) < limit
+        chunk = raw[:limit]
+        records, consumed, torn, pending = parse(
+            chunk, object_hook=_json_object_hook
+        )
+        # a sealed segment is immutable: a trailing-invalid line cannot
+        # be an in-flight append — it is torn
+        n_torn = torn + pending
+        entry = dict(entry)
+        if short:
+            fixed = False
+            if repair:
+                entry["bytes"] = consumed
+                entry["records"] = len(records)
+                entry["crc32"] = "%08x" % (
+                    _zlib.crc32(raw[:consumed]) & 0xFFFFFFFF
+                )
+                manifest_dirty = True
+                fixed = True
+            report.add(
+                "FS411", path,
+                f"sealed segment shorter than its manifest entry "
+                f"({len(raw)} < {limit} bytes)",
+                repaired=fixed,
+                action=(f"re-pinned entry to valid prefix ({consumed} "
+                        f"bytes, {len(records)} records)") if fixed else "",
+            )
+        elif n_torn:
+            # FS410: torn record(s) inside the sealed range
+            fixed = False
+            action = ""
+            if repair:
+                from .. import journal_io
+
+                from ..parallel.file_trials import _json_default
+
+                blob = b"".join(
+                    journal_io.frame_record(r, default=_json_default)
+                    for r in records
+                )
+                try:
+                    # durability: exempt(offline repair: fsck runs single-writer against a stopped queue)
+                    _atomic_write(path, blob, fsync_kind="segment")
+                    entry["bytes"] = len(blob)
+                    entry["records"] = len(records)
+                    entry["crc32"] = "%08x" % (
+                        _zlib.crc32(blob) & 0xFFFFFFFF
+                    )
+                    manifest_dirty = True
+                    fixed = True
+                    action = (
+                        f"rewrote segment keeping {len(records)} valid "
+                        f"record(s)"
+                    )
+                except OSError:
+                    pass
+            report.add(
+                "FS410", path,
+                f"{n_torn} torn record(s) in sealed segment",
+                repaired=fixed, action=action,
+            )
+        elif entry.get("crc32") and entry["crc32"] != (
+            "%08x" % (_zlib.crc32(chunk) & 0xFFFFFFFF)
+        ):
+            # parseable but the sealed-range CRC moved: in-place rot
+            fixed = False
+            if repair:
+                entry["crc32"] = "%08x" % (_zlib.crc32(chunk) & 0xFFFFFFFF)
+                manifest_dirty = True
+                fixed = True
+            report.add(
+                "FS411", path,
+                "sealed-range CRC does not match its manifest entry",
+                repaired=fixed,
+                action="recomputed entry CRC" if fixed else "",
+            )
+        sealed_out.append(entry)
+        for rec in records:
+            view[int(rec["tid"])] = rec
+
+    active = manifest.get("active")
+    if active:
+        path = os.path.join(sdir, active)
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            raw = None  # an active segment not yet appended to is normal
+        if raw is not None:
+            records, consumed, torn, pending = parse(
+                raw, object_hook=_json_object_hook
+            )
+            # offline there is no in-flight appender: a pending trailing
+            # line is a torn final append
+            n_torn = torn + pending
+            if n_torn:
+                fixed = False
+                action = ""
+                if repair:
+                    from .. import journal_io
+
+                    from ..parallel.file_trials import _json_default
+
+                    blob = b"".join(
+                        journal_io.frame_record(r, default=_json_default)
+                        for r in records
+                    )
+                    try:
+                        # durability: exempt(offline repair: fsck runs single-writer against a stopped queue)
+                        _atomic_write(path, blob, fsync_kind="segment")
+                        fixed = True
+                        action = (
+                            f"rewrote active segment keeping "
+                            f"{len(records)} valid record(s)"
+                        )
+                    except OSError:
+                        pass
+                report.add(
+                    "FS410", path,
+                    f"{n_torn} torn record(s) at active segment tail",
+                    repaired=fixed, action=action,
+                )
+            for rec in records:
+                view[int(rec["tid"])] = rec
+
+    # FS412: segment files referenced by neither sealed list nor active
+    referenced = {e["name"] for e in sealed_out} | {
+        e.get("name") for e in manifest.get("sealed", ())
+    }
+    if active:
+        referenced.add(active)
+    for path in seg_paths:
+        if os.path.basename(path) in referenced:
+            continue
+        fixed = False
+        if repair:
+            try:
+                os.unlink(path)
+                fixed = True
+            except OSError:
+                pass
+        report.add(
+            "FS412", path,
+            "orphaned segment file (compactor killed before retiring it)",
+            repaired=fixed, action="deleted" if fixed else "",
+        )
+
+    if repair and manifest_dirty:
+        manifest = dict(manifest)
+        manifest["sealed"] = sealed_out
+        # bump the epoch: cached readers must full-replay the repaired
+        # lineage instead of trusting pinned offsets into rewritten files
+        manifest["epoch"] = int(manifest.get("epoch", 0)) + 1
+        try:
+            # durability: exempt(offline repair: fsck runs single-writer against a stopped queue)
+            _write_doc(manifest_path, manifest, fsync_kind="segment")
+        except OSError:
+            pass
+    return view
+
+
 def fsck_queue(qdir, repair=False, report: FsckReport = None) -> FsckReport:
     """Check (and optionally repair) ONE FileTrials queue directory."""
     qdir = os.path.abspath(qdir)
@@ -350,6 +641,20 @@ def fsck_queue(qdir, repair=False, report: FsckReport = None) -> FsckReport:
             max_doc_draw, int(doc.get("misc", {}).get("service_draw", 0))
         )
 
+    # -- segmented store (FS410/FS411/FS412) ------------------------------
+    # replayed segment docs join the same tables, so the lease/lock/
+    # cursor/counter rules work identically on either backend; a doc
+    # file AND a segment record for one tid is the benign mid-migration
+    # leftover (migrate appends before unlinking), not FS404
+    for tid, doc in sorted(_fsck_segments(qdir, repair, report).items()):
+        report.n_docs += 1
+        if tid not in docs_by_tid:
+            docs_by_tid[tid] = doc
+            seen_states[tid] = doc["state"]
+        max_doc_draw = max(
+            max_doc_draw, int(doc.get("misc", {}).get("service_draw", 0))
+        )
+
     # -- leases (FS402) ---------------------------------------------------
     for path in sorted(glob.glob(os.path.join(qdir, "leases", "*.lease"))):
         tid = _tid_from_name(path, ".lease")
@@ -392,7 +697,7 @@ def fsck_queue(qdir, repair=False, report: FsckReport = None) -> FsckReport:
                    action="deleted" if fixed else "")
 
     # -- tmp droppings (FS406) --------------------------------------------
-    for sub in ("trials", "locks", "leases", "attachments"):
+    for sub in ("trials", "locks", "leases", "attachments", "segments"):
         for path in sorted(glob.glob(os.path.join(qdir, sub, "*.tmp.*"))):
             fixed = False
             if repair:

@@ -170,6 +170,23 @@ def make_parser():
              "replica silent this long has its studies reclaimed",
     )
     p.add_argument(
+        "--mirror-src-root", default=None, dest="mirror_src_root",
+        help="no-shared-root replication: pull the peer root's sealed "
+             "trial-log segments into this replica's --root on every "
+             "reaper tick (fence-checked cut points), so a takeover "
+             "serves from an already-local, CRC-verified copy.  "
+             "Requires --replica-id",
+    )
+    p.add_argument(
+        "--unsafe-shared-compile-cache", action="store_true",
+        dest="unsafe_shared_compile_cache",
+        help="allow a --compile-cache-dir that another LIVE replica "
+             "already advertises.  The persistent XLA cache and the "
+             "compile-ledger compaction are single-writer; sharing the "
+             "directory between live replicas risks corrupting cache "
+             "entries — off by default, startup refuses the collision",
+    )
+    p.add_argument(
         "--chaos-config", default=None, dest="chaos_config",
         help="TESTING ONLY: JSON ChaosConfig activating seeded "
              "service-plane fault injection (torn writes, connection "
@@ -177,6 +194,29 @@ def make_parser():
              "hook",
     )
     return p
+
+
+def _build_service(options, tracer, cache_dir, advertise_url):
+    return OptimizationService(
+        root=options.root,
+        batch_window=options.batch_window,
+        max_batch=options.max_batch,
+        max_queue=options.max_queue,
+        max_studies=options.max_studies,
+        tracer=tracer,
+        slo_enabled=not options.no_slo,
+        flight_dir=options.flight_dir,
+        compile_cache_dir=cache_dir,
+        warmup=not options.no_warmup,
+        cold_fallback=options.cold_fallback,
+        compile_ledger_path=options.compile_ledger,
+        mesh=options.mesh,
+        replica_id=options.replica_id,
+        advertise_url=advertise_url,
+        replica_ttl=options.replica_ttl,
+        mirror_src_root=options.mirror_src_root,
+        unsafe_shared_compile_cache=options.unsafe_shared_compile_cache,
+    )
 
 
 def main(argv=None):
@@ -248,24 +288,17 @@ def main(argv=None):
                 )
                 return 2
             advertise_url = f"http://{options.host}:{options.port}"
-    service = OptimizationService(
-        root=options.root,
-        batch_window=options.batch_window,
-        max_batch=options.max_batch,
-        max_queue=options.max_queue,
-        max_studies=options.max_studies,
-        tracer=tracer,
-        slo_enabled=not options.no_slo,
-        flight_dir=options.flight_dir,
-        compile_cache_dir=cache_dir,
-        warmup=not options.no_warmup,
-        cold_fallback=options.cold_fallback,
-        compile_ledger_path=options.compile_ledger,
-        mesh=options.mesh,
-        replica_id=options.replica_id,
-        advertise_url=advertise_url,
-        replica_ttl=options.replica_ttl,
-    )
+    if options.mirror_src_root and options.replica_id is None:
+        logger.error("--mirror-src-root requires --replica-id")
+        return 2
+    try:
+        service = _build_service(
+            options, tracer, cache_dir, advertise_url
+        )
+    except ValueError as e:
+        # e.g. a compile cache dir another live replica advertises
+        logger.error("%s", e)
+        return 2
     if service.replica_set is not None:
         logger.info(
             "replica mode: id=%s advertise=%s ttl=%.1fs",

@@ -53,7 +53,6 @@ import os
 import pickle
 import threading
 import time
-import zlib
 from collections import deque
 from functools import partial
 
@@ -330,32 +329,23 @@ class ResponseJournal:
             self._load()
 
     # -- codec ---------------------------------------------------------
+    # Framing/resync/compaction live in hyperopt_tpu.journal_io (shared
+    # with the compile ledger, the chaos injection log, and the
+    # segmented trial store); these thin wrappers pin the journal codec
+    # and keep resilience.fsck's FS407 repair entry points stable.
     def _format_record(self, entry) -> bytes:
         default, _ = _journal_codec()
-        body = json.dumps(entry, default=default, sort_keys=True).encode()
-        return b"\n%08x %s" % (zlib.crc32(body) & 0xFFFFFFFF, body)
+        return tracing.format_record(entry, default=default)
 
     @staticmethod
     def parse_lines(raw: bytes):
         """(entries, n_torn) from raw journal bytes.  Lines that fail
         their CRC or do not parse count as torn and are skipped — only
         an unacknowledged tail record can legitimately be torn."""
+        from .. import journal_io
+
         _, object_hook = _journal_codec()
-        entries, torn = [], 0
-        for line in raw.split(b"\n"):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                crc_hex, body = line.split(b" ", 1)
-                if (zlib.crc32(body) & 0xFFFFFFFF) != int(crc_hex, 16):
-                    raise ValueError("crc mismatch")
-                entries.append(
-                    json.loads(body.decode(), object_hook=object_hook)
-                )
-            except (ValueError, json.JSONDecodeError, UnicodeDecodeError):
-                torn += 1
-        return entries, torn
+        return journal_io.read_records_bytes(raw, object_hook=object_hook)
 
     def _load(self):
         try:
@@ -378,24 +368,19 @@ class ResponseJournal:
                 self._seq = max(self._seq, int(entry.get("seq", 0)))
 
     def _append_line(self, entry):
-        line = self._format_record(entry)
-        # the fsync here is THE durability point of the exactly-once
-        # protocol — and a named phase in every trace that pays it
-        with tracing.span("journal.fsync", n_bytes=len(line)):
-            t0 = time.perf_counter()
-            fd = os.open(self.path, os.O_CREAT | os.O_WRONLY | os.O_APPEND,
-                         0o644)
-            try:
-                os.write(fd, line)
-                os.fsync(fd)
-            finally:
-                os.close(fd)
+        from .. import journal_io
+
+        default, _ = _journal_codec()
+        # the fsync inside append_record is THE durability point of the
+        # exactly-once protocol — and a named phase in every trace that
+        # pays it (journal_io records the fsync into StoreStats)
+        with tracing.span("journal.fsync"):
+            nbytes = journal_io.append_record(
+                self.path, entry, default=default, fsync_kind="journal"
+            )
         stats = _store_telemetry()
         if stats is not None:
-            stats.record_fsync(
-                time.perf_counter() - t0, kind="journal", nbytes=len(line)
-            )
-            stats.record_journal_append(len(line))
+            stats.record_journal_append(nbytes)
 
     # -- API -------------------------------------------------------------
     def get(self, key):
@@ -453,17 +438,18 @@ class ResponseJournal:
                 if self._appends_since_compact > 4 * self.max_entries:
                     # compaction: rewrite with only the live entries
                     # (atomic replace — crash-safe at any point)
-                    from ..parallel.file_trials import _atomic_write
+                    from .. import journal_io
 
-                    blob = b"".join(
-                        self._format_record(self._entries[k])
-                        for k in self._order
+                    default, _ = _journal_codec()
+                    nbytes = journal_io.compact_records(
+                        self.path,
+                        [self._entries[k] for k in self._order],
+                        default=default, fsync_kind="journal",
                     )
-                    _atomic_write(self.path, blob, fsync_kind="journal")
                     self._appends_since_compact = 0
                     stats = _store_telemetry()
                     if stats is not None:
-                        stats.record_journal_compaction(len(blob))
+                        stats.record_journal_compaction(nbytes)
         if self.path:
             chaos = _active_chaos()
             if chaos is not None:
@@ -1768,7 +1754,8 @@ class OptimizationService:
                  cold_fallback=False, compile_ledger_path=None,
                  compile_plane=True, mesh=None, replica_id=None,
                  advertise_url=None, replica_ttl=None,
-                 takeover_prewarm=True):
+                 takeover_prewarm=True, mirror_src_root=None,
+                 unsafe_shared_compile_cache=False):
         self.stats = ServiceStats()
         # mesh execution mode (--mesh auto|DPxSP|off): resolve the spec
         # ONCE — every study's fused prepare, the warmup replay, and
@@ -1885,7 +1872,11 @@ class OptimizationService:
                     "multi-replica mode (replica_id) requires a durable "
                     "--root shared between the replicas"
                 )
-            from .replicas import DEFAULT_REPLICA_LEASE_TTL, ReplicaSet
+            from .replicas import (
+                DEFAULT_REPLICA_LEASE_TTL,
+                ReplicaSet,
+                SegmentMirror,
+            )
 
             self.replica_set = ReplicaSet(
                 root, replica_id, url=advertise_url,
@@ -1893,6 +1884,25 @@ class OptimizationService:
                     DEFAULT_REPLICA_LEASE_TTL if replica_ttl is None
                     else float(replica_ttl)
                 ),
+            )
+            self.replica_set.compile_cache_dir = self.compile_cache_dir
+            if self.compile_cache_dir:
+                self._refuse_shared_compile_cache(
+                    unsafe_shared_compile_cache
+                )
+            if mirror_src_root is not None:
+                # no-shared-root replication: pull the peer's sealed
+                # segments into OUR root so an eventual takeover serves
+                # from a local, already-verified copy
+                self.replica_set.attach_mirror(
+                    SegmentMirror(
+                        mirror_src_root, root, ttl=self.replica_set.ttl
+                    )
+                )
+        elif mirror_src_root is not None:
+            raise ValueError(
+                "mirror_src_root (pull-based segment replication) "
+                "requires multi-replica mode (replica_id)"
             )
         self.registry = StudyRegistry(
             root, max_studies=max_studies, mesh=self.mesh,
@@ -2041,6 +2051,36 @@ class OptimizationService:
             if owned is not None:
                 self.tracer.finish(owned)
 
+    def _refuse_shared_compile_cache(self, unsafe):
+        """Refuse a ``--compile-cache-dir`` that a LIVE sibling replica
+        already advertises.  The persistent XLA cache and the compile
+        ledger's compaction are single-writer; two live replicas
+        pointing at one directory can corrupt each other's entries.
+        ``--unsafe-shared-compile-cache`` overrides (read-mostly NFS
+        setups that accept the risk)."""
+        mine = os.path.abspath(self.compile_cache_dir)
+        for record in self.replica_set.directory.replicas():
+            if record.get("replica_id") == self.replica_set.replica_id:
+                continue  # our own stale record (a restart) is fine
+            if not record.get("live"):
+                continue
+            if record.get("compile_cache_dir") == mine:
+                if unsafe:
+                    logger.warning(
+                        "compile cache dir %s is shared with live "
+                        "replica %s (allowed by "
+                        "--unsafe-shared-compile-cache)",
+                        mine, record.get("replica_id"),
+                    )
+                    return
+                raise ValueError(
+                    f"compile cache dir {mine} is already in use by "
+                    f"live replica {record.get('replica_id')!r}; the "
+                    "persistent cache is single-writer — give each "
+                    "replica its own directory, or pass "
+                    "--unsafe-shared-compile-cache to override"
+                )
+
     def _run_startup_fsck(self, root):
         from ..resilience.fsck import fsck_path
 
@@ -2117,6 +2157,18 @@ class OptimizationService:
             # the claim overwrites it)
             prior = rs.leases.read(study_id)
             t0 = time.monotonic()
+            if rs.mirror is not None:
+                # no-shared-root mode: take a final fence-checked pull
+                # so the local copy includes every segment the dying
+                # owner sealed (the periodic reaper-tick pulls make
+                # this a near-noop)
+                try:
+                    rs.mirror.pull_study(study_id)
+                except Exception:
+                    logger.exception(
+                        "final pre-takeover pull failed for %r; "
+                        "serving from the last mirrored cut", study_id,
+                    )
             handle = rs.try_claim(study_id)
             if handle is None:
                 return False  # a live owner beat us to it

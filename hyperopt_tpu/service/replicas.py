@@ -50,6 +50,7 @@ import logging
 import os
 import threading
 import time
+import zlib
 from collections import deque
 
 from ..parallel.file_trials import (
@@ -58,6 +59,14 @@ from ..parallel.file_trials import (
     _decode_doc,
     _write_doc,
 )
+
+
+def _segment_stats():
+    """The process-wide StoreStats (None when observability is off)."""
+    from ..parallel.file_trials import store_stats
+
+    return store_stats()
+
 
 logger = logging.getLogger(__name__)
 
@@ -377,17 +386,23 @@ class ReplicaDirectory:
             self.registry_dir, f"{_validate_replica_id(replica_id)}.json"
         )
 
-    def advertise(self, replica_id, url):
+    def advertise(self, replica_id, url, compile_cache_dir=None):
         os.makedirs(self.registry_dir, exist_ok=True)
+        record = {
+            "replica_id": _validate_replica_id(replica_id),
+            "url": url,
+            "heartbeat_at": time.time(),
+            "pid": os.getpid(),
+        }
+        if compile_cache_dir:
+            # advertised so siblings can detect an accidentally-shared
+            # persistent compile cache (refused at startup: the ledger's
+            # compaction is single-writer)
+            record["compile_cache_dir"] = os.path.abspath(
+                compile_cache_dir
+            )
         _write_doc(
-            self.record_path(replica_id),
-            {
-                "replica_id": _validate_replica_id(replica_id),
-                "url": url,
-                "heartbeat_at": time.time(),
-                "pid": os.getpid(),
-            },
-            fsync_kind="attachment",
+            self.record_path(replica_id), record, fsync_kind="attachment"
         )
 
     def withdraw(self, replica_id):
@@ -527,6 +542,193 @@ def read_discovery(path) -> list:
     return [str(u) for u in doc]
 
 
+class SegmentMirror:
+    """Pull-based sealed-segment replication: the warm-failover data
+    plane for replicas that do NOT share a filesystem root.
+
+    A failover target pre-warms a study by pulling the owner's sealed
+    segments from ``src_root`` into its own ``dst_root``.  Sealed
+    segments are immutable and content-addressed by the manifest
+    (name + byte count + CRC), so a pull is a plain byte copy that can
+    be verified end-to-end and repeated idempotently — a segment
+    already present at the manifest's size is never re-read.
+
+    Cut-point contract (fence-checked):
+
+    1. read the study's fence token from the source replica plane
+       (``fence_before``);
+    2. snapshot the source manifest read-only and copy every sealed
+       entry's committed prefix (exactly ``entry["bytes"]`` bytes,
+       CRC-verified against the entry) plus the study's sidecar state
+       (config / seed cursor / response journal attachments and the id
+       counter);
+    3. re-read the fence.  If it moved, ownership changed mid-pull:
+       the copied segments are KEPT (immutable, identical under any
+       owner) but the manifest snapshot is not published — without a
+       manifest the dst store ignores them, and the next pull retries
+       from the new cut;
+    4. publish the manifest snapshot last, by atomic replace.  The dst
+       store now replays a consistent committed prefix of the owner's
+       log: every state the owner sealed before the cut, none of its
+       in-flight active tail.
+
+    The active segment is never pulled — the owner's graceful handover
+    (or the takeover fsck) seals it, which rolls those records into the
+    next cut.  ``pull_study`` raises nothing; it returns a summary dict
+    with ``ok``/``reason`` so callers can poll it from maintenance
+    loops.
+    """
+
+    def __init__(self, src_root, dst_root,
+                 ttl=DEFAULT_REPLICA_LEASE_TTL):
+        self.src_root = os.path.abspath(src_root)
+        self.dst_root = os.path.abspath(dst_root)
+        if self.src_root == self.dst_root:
+            raise ValueError(
+                "SegmentMirror needs distinct roots: pulling a root "
+                "into itself would republish its own manifest"
+            )
+        self.leases = StudyLeaseStore(self.src_root, ttl=ttl)
+
+    def _study_dirs(self, study_id):
+        src = os.path.join(self.src_root, "studies", str(study_id))
+        dst = os.path.join(self.dst_root, "studies", str(study_id))
+        return src, dst
+
+    def pull_study(self, study_id) -> dict:
+        from ..parallel import segment_store as sstore
+        from ..parallel.file_trials import _read_doc, attachment_filename
+        from .core import (
+            RESPONSE_JOURNAL_ATTACHMENT,
+            SEED_CURSOR_ATTACHMENT,
+            STUDY_CONFIG_ATTACHMENT,
+        )
+
+        study_id = str(study_id)
+        out = {"study": study_id, "ok": False, "n_pulled": 0,
+               "nbytes": 0}
+        src_q, dst_q = self._study_dirs(study_id)
+        manifest_path = os.path.join(
+            src_q, "segments", sstore.MANIFEST_NAME
+        )
+        fence_before = self.leases.read_fence(study_id)
+        # read-only snapshot: never instantiate a SegmentStore on the
+        # source — its load path publishes a manifest as a side effect
+        manifest = _read_doc(manifest_path, quarantine=False)
+        if manifest is None:
+            out["reason"] = "no readable source manifest (not segmented?)"
+            return out
+        os.makedirs(os.path.join(dst_q, "segments"), exist_ok=True)
+        n_pulled = 0
+        nbytes = 0
+        for entry in manifest.get("sealed", ()):
+            try:
+                name = str(entry["name"])
+                limit = int(entry["bytes"])
+            except (KeyError, TypeError, ValueError):
+                out["reason"] = "malformed sealed entry in manifest"
+                return out
+            if os.path.sep in name or name == sstore.MANIFEST_NAME:
+                out["reason"] = f"unsafe segment name {name!r}"
+                return out
+            dst_path = os.path.join(dst_q, "segments", name)
+            try:
+                if os.path.getsize(dst_path) == limit:
+                    continue  # immutable once sealed: already mirrored
+            except OSError:
+                pass
+            try:
+                with open(os.path.join(src_q, "segments", name),
+                          "rb") as f:
+                    raw = f.read(limit)
+            except OSError:
+                out["reason"] = f"sealed segment {name} unreadable"
+                return out
+            want_crc = entry.get("crc32")
+            got_crc = "%08x" % (zlib.crc32(raw) & 0xFFFFFFFF)
+            if len(raw) != limit or (want_crc and want_crc != got_crc):
+                out["reason"] = (
+                    f"sealed segment {name} fails its manifest CRC "
+                    "(concurrent compaction? retry next tick)"
+                )
+                return out
+            _atomic_write(dst_path, raw, fsync_kind="segment")
+            n_pulled += 1
+            nbytes += len(raw)
+        # sidecar state a takeover needs to resume deterministically:
+        # study config, seed cursor, response journal, id counter
+        sidecars = [
+            os.path.join(
+                "attachments", attachment_filename(key)
+            )
+            for key in (
+                STUDY_CONFIG_ATTACHMENT,
+                SEED_CURSOR_ATTACHMENT,
+                RESPONSE_JOURNAL_ATTACHMENT,
+            )
+        ]
+        sidecars.append("ids.counter")
+        for rel in sidecars:
+            try:
+                with open(os.path.join(src_q, rel), "rb") as f:
+                    raw = f.read()
+            except OSError:
+                continue  # absent sidecars are normal (fresh study)
+            dst_path = os.path.join(dst_q, rel)
+            os.makedirs(os.path.dirname(dst_path), exist_ok=True)
+            _atomic_write(dst_path, raw, fsync_kind="attachment")
+            nbytes += len(raw)
+        fence_after = self.leases.read_fence(study_id)
+        if fence_after != fence_before:
+            out["reason"] = (
+                f"fence moved {fence_before}->{fence_after} mid-pull; "
+                "segments kept, manifest withheld"
+            )
+            return out
+        _write_doc(
+            os.path.join(dst_q, "segments", sstore.MANIFEST_NAME),
+            manifest,
+            fsync_kind="segment",
+        )
+        stats = _segment_stats()
+        if stats is not None:
+            stats.record_segment_pull(n_pulled, nbytes)
+        out.update(
+            ok=True,
+            n_pulled=n_pulled,
+            nbytes=nbytes,
+            fence=fence_before,
+            epoch=int(manifest.get("epoch", 0)),
+            n_sealed=len(manifest.get("sealed", ())),
+        )
+        return out
+
+    def pull_all(self) -> list:
+        """Pull every study visible at the source; returns the per-study
+        summaries (mirroring is advisory — failures surface as
+        ``ok=False`` reasons, never exceptions)."""
+        studies_dir = os.path.join(self.src_root, "studies")
+        try:
+            names = sorted(os.listdir(studies_dir))
+        except OSError:
+            return []
+        out = []
+        for study_id in names:
+            if not os.path.isdir(os.path.join(studies_dir, study_id)):
+                continue
+            try:
+                out.append(self.pull_study(study_id))
+            except Exception:
+                logger.exception(
+                    "segment pull failed for study %r", study_id
+                )
+                out.append(
+                    {"study": study_id, "ok": False,
+                     "reason": "unexpected error (see log)"}
+                )
+        return out
+
+
 class ReplicaStats:
     """Counters + bounded takeover log for the replica plane — the
     ``/metrics`` gauge source and the SL608 failover-MTTR feed."""
@@ -660,6 +862,8 @@ class ReplicaSet:
         self.ttl = float(ttl)
         self.leases = StudyLeaseStore(self.root, ttl=self.ttl)
         self.directory = ReplicaDirectory(self.root, ttl=self.ttl)
+        self.compile_cache_dir = None  # advertised when the service sets it
+        self.mirror = None  # optional SegmentMirror (pulled each reap tick)
         self.stats = (
             stats if stats is not None
             else ReplicaStats(mttr_bound_s=mttr_bound_s)
@@ -755,7 +959,10 @@ class ReplicaSet:
     # -- heartbeat ------------------------------------------------------
     def _heartbeat_once(self):
         try:
-            self.directory.advertise(self.replica_id, self.url)
+            self.directory.advertise(
+                self.replica_id, self.url,
+                compile_cache_dir=self.compile_cache_dir,
+            )
         except OSError:
             logger.warning("replica advertise failed", exc_info=True)
         self.stats.record("heartbeat")
@@ -877,9 +1084,23 @@ class ReplicaSet:
                 fails, time.monotonic() + delay
             )
 
+    def attach_mirror(self, mirror):
+        """Install a :class:`SegmentMirror` pulled on every reaper tick,
+        so an eventual takeover starts from an already-warm local copy
+        of every sealed segment."""
+        self.mirror = mirror
+        return self
+
     def _reap_loop(self):
         interval = max(self.ttl / 4.0, 0.05)
         while not self._stop.wait(interval):
+            if self.mirror is not None:
+                try:
+                    self.mirror.pull_all()
+                except Exception:
+                    logger.exception(
+                        "segment mirror pull failed; continuing"
+                    )
             try:
                 self.reap_once()
             except Exception:

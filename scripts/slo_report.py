@@ -7,9 +7,9 @@ Four sections, each a gate:
    every rule must be ``ok`` or ``no_data`` (nothing breaching), the
    warm/cold latency split must attribute the tail, and the
    storage-plane counters must RECONCILE against trial counts (one
-   insert + one result write per trial, one journal append per keyed
-   mutation, one directory scan per study create, zero scans on the
-   serve hot path).
+   segment append per trial-state transition, one journal append per
+   keyed mutation, zero per-doc writes and ZERO directory scans
+   anywhere on the segmented default backend).
 2. **fixtures** — one seeded forced-breach fixture per rule: synthetic
    stats driven through a real :class:`hyperopt_tpu.slo.SloEngine` +
    :class:`~hyperopt_tpu.slo.FlightRecorder` (deterministic clock),
@@ -86,21 +86,25 @@ def healthy_section(n_studies, n_trials, seed):
     # loadgen path accounted against trial counts.  The run is
     # hermetic (no transport faults, no chaos), so these are EXACT.
     expected = {
-        # one insert per suggest + one result write per report
-        "doc_writes": 2 * total_trials,
+        # segmented store (the default backend): NO per-doc writes and
+        # NO O(N) directory scans anywhere — every trial-state
+        # transition is one segment append (one record each on this
+        # unbatched path: insert per suggest + result write per report)
+        "doc_writes": 0,
+        "scans": 0,
+        "segment_appends": 2 * total_trials,
+        "segment_records": 2 * total_trials,
         # one journaled response per keyed mutation:
         # create(1/study) + suggest(1/trial) + report(1/trial)
         "journal_appends": n_studies + 2 * total_trials,
-        # O(N) directory scans: exactly one per study create (the
-        # initial FileTrials refresh); the serve hot path runs on
-        # refresh_local and adds ZERO
-        "scans": n_studies,
         # derived Trials-view recomputes: one per insert + one per
         # report, all local
         "refresh_local": 2 * total_trials,
         "refresh_full": n_studies,
         # fsync ledger per kind
-        "fsync_doc": 2 * total_trials,
+        "fsync_doc": 0,
+        # manifest publish per study create + one per segment append
+        "fsync_segment": n_studies + 2 * total_trials,
         "fsync_journal": n_studies + 2 * total_trials,
         "fsync_counter": total_trials,          # one id draw per suggest
         # config blob per create + seed-cursor per suggest commit
@@ -108,11 +112,14 @@ def healthy_section(n_studies, n_trials, seed):
     }
     observed = {
         "doc_writes": store["doc_writes"],
-        "journal_appends": store["journal_appends"],
         "scans": store["scans"],
+        "segment_appends": store["segment_appends"],
+        "segment_records": store["segment_records"],
+        "journal_appends": store["journal_appends"],
         "refresh_local": store["refresh_local"],
         "refresh_full": store["refresh_full"],
         "fsync_doc": store["fsyncs"].get("doc", 0),
+        "fsync_segment": store["fsyncs"].get("segment", 0),
         "fsync_journal": store["fsyncs"].get("journal", 0),
         "fsync_counter": store["fsyncs"].get("counter", 0),
         "fsync_attachment": store["fsyncs"].get("attachment", 0),

@@ -1,0 +1,273 @@
+"""Store-plane A/B: the per-doc layout vs the segmented trial log.
+
+The PR 16 acceptance artifact (``BENCH_STORE.json``): for each scale,
+drive the SAME trial lifecycle — B-sized insert batches, then a result
+transition per trial — through both backends with a fresh
+:class:`~hyperopt_tpu.observability.StoreStats` installed, and report
+the counter evidence:
+
+- **fsyncs per state transition** — the group-commit win.  The per-doc
+  layout pays one ``fsync`` per transition (atomic tmp+replace per
+  doc); the segment log folds a B-record batch into ONE ``O_APPEND``
+  write + ONE ``fsync``.  The headline gate is the ratio ``doc /
+  segment >= 10`` at every scale.
+- **refresh ∝ delta** — after the store is loaded, appending a small
+  delta and refreshing a warm reader replays exactly the delta's
+  records (``segment_replay_records`` == delta), with zero O(N)
+  directory scans on the segmented path.
+- **recovery = replay** — a cold open replays the full log
+  (``replayed records == total records``), and compaction folds the
+  2-records-per-trial history down to one latest doc per tid.
+
+Every committed guard is a RATIO or COUNT — never absolute
+milliseconds (sandbox wall-clock swings ~30x between sessions).
+Wall-clock fields are informational only.
+
+Usage::
+
+    python scripts/store_bench.py [--quick] [--out BENCH_STORE.json]
+    python bench.py --store [--quick]     # the bench.py section
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+BATCH = 64
+FULL_SCALES = (10_000, 100_000)
+QUICK_SCALES = (2_000,)
+
+
+def _doc(tid):
+    return {
+        "tid": tid, "state": 0, "spec": None,
+        "result": {"status": "new"},
+        "misc": {"tid": tid, "cmd": None, "idxs": {"x": [tid]},
+                 "vals": {"x": [0.5]}},
+        "exp_key": None, "owner": None, "version": 0,
+        "book_time": None, "refresh_time": None,
+    }
+
+
+def _fresh_stats():
+    from hyperopt_tpu.observability import StoreStats
+    from hyperopt_tpu.parallel import file_trials
+
+    stats = StoreStats()
+    file_trials.set_store_stats(stats)
+    return stats
+
+
+def _store_fsyncs(summary) -> int:
+    """fsyncs attributable to trial-state durability (doc + segment),
+    excluding counter/attachment/journal traffic both arms share."""
+    fsyncs = summary["fsyncs"]
+    return fsyncs.get("doc", 0) + fsyncs.get("segment", 0)
+
+
+def bench_backend(root, backend, n_trials, batch=BATCH) -> dict:
+    """One arm: insert ``n_trials`` in ``batch``-sized groups, then a
+    result transition per trial (also batched through the group-commit
+    path on the segmented backend), then delta refresh, cold-open
+    recovery, and (segmented) compaction — all counter-measured."""
+    from hyperopt_tpu.parallel.file_trials import FileJobs, FileTrials
+
+    qdir = os.path.join(root, f"{backend}-{n_trials}")
+    row = {"backend": backend, "n_trials": n_trials, "batch": batch,
+           "transitions": 2 * n_trials}
+
+    # -- write path: create + complete every trial ---------------------
+    stats = _fresh_stats()
+    t0 = time.time()
+    jobs = FileJobs(qdir, backend=backend)
+    for base in range(0, n_trials, batch):
+        docs = [_doc(t) for t in range(base, min(base + batch, n_trials))]
+        jobs.insert_many(docs)
+    for base in range(0, n_trials, batch):
+        done = []
+        for t in range(base, min(base + batch, n_trials)):
+            d = _doc(t)
+            d["state"] = 2
+            d["result"] = {"status": "ok", "loss": float(t)}
+            done.append(d)
+        if jobs.segments is not None:
+            jobs.segments.append_many(done)
+        else:
+            for d in done:
+                jobs.write(d)
+    write_s = time.time() - t0
+    s = stats.summary()
+    fsyncs = _store_fsyncs(s)
+    row["write"] = {
+        "elapsed_s_informational": round(write_s, 3),
+        "fsyncs_store": fsyncs,
+        "fsyncs_per_transition": round(fsyncs / (2 * n_trials), 6),
+        "doc_writes": s["doc_writes"],
+        "segment_appends": s["segment_appends"],
+        "segment_records": s["segment_records"],
+        "scans": s["scans"],
+    }
+
+    # -- recovery = replay-in-order on a cold open ---------------------
+    stats = _fresh_stats()
+    reader = FileTrials(qdir, backend=backend)
+    reader.refresh()
+    cold = stats.summary()
+    row["cold_open"] = {
+        "replayed_records": cold["segment_replay_records"],
+        "full_replays": cold["segment_replays_full"],
+        "scans": cold["scans"],
+        "scan_entries": cold["scan_entries"],
+        "n_docs_recovered": len(reader._dynamic_trials),
+    }
+
+    # -- refresh ∝ delta: the warm reader pays only the tail a SIBLING
+    # writer appended (its own inserts never need replay) --------------
+    stats = _fresh_stats()
+    delta = [_doc(n_trials + i) for i in range(batch)]
+    jobs.insert_many(delta)
+    reader.refresh()
+    warm = stats.summary()
+    row["delta_refresh"] = {
+        "delta_docs": len(delta),
+        "replayed_records": warm["segment_replay_records"],
+        "full_replays": warm["segment_replays_full"],
+        "scans": warm["scans"],
+        "scan_entries": warm["scan_entries"],
+    }
+
+    # -- compaction: 2 records/trial fold to latest-per-tid ------------
+    if jobs.segments is not None:
+        stats = _fresh_stats()
+        segs = jobs.segments
+        # one record per append: n inserts + n results + the delta batch
+        records_before = 2 * n_trials + batch
+        t0 = time.time()
+        segs.seal_active()
+        segs.compact()
+        s = stats.summary()
+        stats2 = _fresh_stats()
+        reopened = FileJobs(qdir, backend=backend)
+        n_after = len(reopened.all_docs())
+        after = stats2.summary()
+        row["compaction"] = {
+            "elapsed_s_informational": round(time.time() - t0, 3),
+            "records_before": records_before,
+            "replay_records_after": after["segment_replay_records"],
+            "n_docs_after": n_after,
+            "segments_retired": s["segments_retired"],
+        }
+    return row
+
+
+def run_campaign(quick=False) -> dict:
+    os.environ.setdefault("HYPEROPT_TPU_STORE_BACKEND", "segment")
+    scales = QUICK_SCALES if quick else FULL_SCALES
+    report = {
+        "campaign": "store_bench",
+        "quick": bool(quick),
+        "batch": BATCH,
+        "scales": list(scales),
+        "rows": [],
+        "headline": {"fsync_ratio_doc_over_segment": {}},
+        "errors": [],
+    }
+    with tempfile.TemporaryDirectory(prefix="store-bench-") as root:
+        for n in scales:
+            by_backend = {}
+            for backend in ("doc", "segment"):
+                row = bench_backend(root, backend, n)
+                report["rows"].append(row)
+                by_backend[backend] = row
+            doc_f = by_backend["doc"]["write"]["fsyncs_per_transition"]
+            seg_f = by_backend["segment"]["write"][
+                "fsyncs_per_transition"
+            ]
+            ratio = round(doc_f / seg_f, 2) if seg_f else None
+            report["headline"]["fsync_ratio_doc_over_segment"][
+                str(n)
+            ] = ratio
+            if ratio is None or ratio < 10.0:
+                report["errors"].append(
+                    f"fsync ratio at n={n} is {ratio} (< 10x)"
+                )
+            seg = by_backend["segment"]
+            if seg["write"]["scans"] != 0:
+                report["errors"].append(
+                    f"segmented write path did {seg['write']['scans']} "
+                    f"O(N) scans at n={n}"
+                )
+            if seg["delta_refresh"]["scans"] != 0:
+                report["errors"].append(
+                    f"segmented delta refresh scanned at n={n}"
+                )
+            if seg["delta_refresh"]["full_replays"] != 0:
+                report["errors"].append(
+                    f"segmented delta refresh fell back to a full "
+                    f"replay at n={n}"
+                )
+            if (seg["delta_refresh"]["replayed_records"]
+                    != seg["delta_refresh"]["delta_docs"]):
+                report["errors"].append(
+                    f"delta refresh replayed "
+                    f"{seg['delta_refresh']['replayed_records']} records "
+                    f"for a {seg['delta_refresh']['delta_docs']}-doc "
+                    f"delta at n={n}"
+                )
+            if seg["cold_open"]["n_docs_recovered"] != n:
+                report["errors"].append(
+                    f"cold open recovered "
+                    f"{seg['cold_open']['n_docs_recovered']}/{n} docs"
+                )
+            if seg["cold_open"]["replayed_records"] != 2 * n:
+                report["errors"].append(
+                    f"cold open replayed "
+                    f"{seg['cold_open']['replayed_records']} records, "
+                    f"expected the full {2 * n}-record log"
+                )
+            comp = seg.get("compaction", {})
+            if comp and comp["n_docs_after"] != n + BATCH:
+                report["errors"].append(
+                    f"compaction lost docs at n={n}: "
+                    f"{comp['n_docs_after']} != {n + BATCH}"
+                )
+    report["ok"] = not report["errors"]
+    return report
+
+
+def write_report(report, path):
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+    out = args.out or (
+        "BENCH_STORE.quick.json" if args.quick else "BENCH_STORE.json"
+    )
+    report = run_campaign(quick=args.quick)
+    write_report(report, out)
+    print(json.dumps({
+        "campaign": report["campaign"],
+        "ok": report["ok"],
+        "fsync_ratio_doc_over_segment":
+            report["headline"]["fsync_ratio_doc_over_segment"],
+        "errors": report["errors"],
+        "artifact": out,
+    }))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -675,3 +675,62 @@ def test_failover_serve_artifact_proves_warm_takeover():
     assert integ["mismatched_studies"] == []
     assert d["trajectories_match_fault_free"] is True
     assert d["fsck_after_repair"]["clean"] is True
+
+
+# ---------------------------------------------------------------------
+# BENCH_STORE.json — the PR 16 segmented-trial-store artifact
+# ---------------------------------------------------------------------
+
+BENCH_STORE = os.path.join(ROOT, "BENCH_STORE.json")
+
+
+@pytest.mark.skipif(
+    not os.path.exists(BENCH_STORE), reason="no committed store artifact"
+)
+def test_store_artifact_proves_the_segment_log_wins():
+    """The PR 16 acceptance artifact (``bench.py --store``): the
+    segmented trial log vs the per-doc layout at 10k AND 100k trials.
+    Every guard is a RATIO or COUNT — never absolute milliseconds
+    (sandbox wall-clock swings ~30x between sessions)."""
+    d = _load(BENCH_STORE)
+    assert d["campaign"] == "store_bench"
+    assert d["ok"] is True
+    assert d["errors"] == []
+    # the committed artifact is the FULL capture (quick runs write
+    # BENCH_STORE.quick.json and must never clobber this one)
+    assert d["quick"] is False
+    assert set(d["scales"]) >= {10_000, 100_000}
+    ratios = d["headline"]["fsync_ratio_doc_over_segment"]
+    for n in d["scales"]:
+        # the group-commit headline: >=10x fewer fsyncs per transition
+        assert ratios[str(n)] >= 10.0, (n, ratios)
+    rows = {(r["backend"], r["n_trials"]): r for r in d["rows"]}
+    for n in d["scales"]:
+        doc, seg = rows[("doc", n)], rows[("segment", n)]
+        # per-doc pays one fsync per transition; the segment log folds
+        # a whole batch into one
+        assert doc["write"]["fsyncs_per_transition"] >= 1.0
+        assert seg["write"]["fsyncs_per_transition"] <= 0.1
+        assert seg["write"]["doc_writes"] == 0
+        # group commit on record: far fewer write calls than records
+        assert seg["write"]["segment_records"] == 2 * n
+        assert seg["write"]["segment_appends"] * 10 <= (
+            seg["write"]["segment_records"]
+        )
+        # zero O(N) scans anywhere on the segmented path
+        assert seg["write"]["scans"] == 0
+        assert seg["delta_refresh"]["scans"] == 0
+        # refresh ∝ delta: the warm reader replays exactly the delta
+        dr = seg["delta_refresh"]
+        assert dr["replayed_records"] == dr["delta_docs"] == d["batch"]
+        assert dr["full_replays"] == 0
+        # recovery = replay the full log, losslessly
+        assert seg["cold_open"]["replayed_records"] == 2 * n
+        assert seg["cold_open"]["n_docs_recovered"] == n
+        # compaction folds 2 records/trial to latest-per-tid, lossless
+        comp = seg["compaction"]
+        assert comp["n_docs_after"] == n + d["batch"]
+        assert comp["records_before"] > comp["n_docs_after"]
+        # the doc arm's delta refresh is the O(N) rescan the segment
+        # path exists to dodge
+        assert doc["delta_refresh"]["scan_entries"] >= n

@@ -433,8 +433,8 @@ class TestCrashInjection:
             time.sleep(0.05)
         return proc
 
-    def _seed_queue(self, qdir):
-        jobs = FileJobs(qdir)
+    def _seed_queue(self, qdir, backend=None):
+        jobs = FileJobs(qdir, backend=backend)
         jobs.insert({
             "tid": 0, "state": JOB_STATE_NEW, "spec": None,
             "result": {"status": "new"},
@@ -496,7 +496,10 @@ time.sleep(300)  # SIGKILLed here, reservation held
 
         qdir = str(tmp_path / "q")
         ready = str(tmp_path / "ready")
-        jobs = self._seed_queue(qdir)
+        # the per-doc backend: this test pins the atomic-replace torn-write
+        # window (os.replace stubbed below), which the segmented backend
+        # replaces with the torn-segment-tail discipline (test_fsck FS410)
+        jobs = self._seed_queue(qdir, backend="doc")
         # the child stalls INSIDE the result write: tmp file written and
         # fsynced, the atomic os.replace not yet executed — the kill lands
         # exactly in the torn-write window

@@ -29,9 +29,11 @@ from hyperopt_tpu.service import OptimizationService
 SPACE = {"x": hp.uniform("x", -5, 5)}
 
 
-def _mk_queue(tmp_path, n_docs=3):
+def _mk_queue(tmp_path, n_docs=3, backend="doc"):
+    # the FS401-FS408 catalog exercises the per-doc layout; the
+    # segmented layout has its own FS410-FS412 catalog below
     qdir = str(tmp_path / "q")
-    trials = FileTrials(qdir)
+    trials = FileTrials(qdir, backend=backend)
     docs = []
     for tid in trials.new_trial_ids(n_docs):
         doc = {
@@ -215,7 +217,10 @@ class TestServiceRules:
         svc.close()
         return root, os.path.join(root, "studies", "s"), tids
 
-    def test_fs401_restore_from_journal(self, tmp_path):
+    def test_fs401_restore_from_journal(self, tmp_path, monkeypatch):
+        # FS401 journal restore is a per-doc-layout rule: pin the study
+        # to the legacy backend (segmented tears are FS410's business)
+        monkeypatch.setenv("HYPEROPT_TPU_STORE_BACKEND", "doc")
         root, qdir, tids = self._service_study(tmp_path)
         victim = os.path.join(qdir, "trials", f"{tids[0]:012d}.json")
         with open(victim, "r+b") as f:
@@ -338,6 +343,29 @@ class TestTmpDroppingGC:
         reaper.reap_once()
         assert not os.path.exists(old)
         assert stats.get("tmp_dropping_cleared") == 1
+
+    def test_requeue_stale_gcs_segment_tmp_droppings(self, tmp_path):
+        """The segmented layout's tmp naming (manifest publishes,
+        compaction rewrites) is in the GC sweep too — a crash between
+        tmp-write and atomic replace must not leak files forever."""
+        qdir, trials, docs = _mk_queue(tmp_path, backend="segment")
+        old_manifest = self._dropping(
+            qdir, "segments", "MANIFEST.json.tmp.11.3"
+        )
+        old_seg = self._dropping(
+            qdir, "segments", "seg-00000001.log.tmp.11.4"
+        )
+        fresh = self._dropping(
+            qdir, "segments", "MANIFEST.json.tmp.12.1", age=0.0
+        )
+        trials.jobs.requeue_stale(30.0)
+        assert not os.path.exists(old_manifest)
+        assert not os.path.exists(old_seg)
+        assert os.path.exists(fresh)  # young: may be a publish in flight
+        # the live store is untouched by the sweep
+        assert sorted(d["tid"] for d in trials.jobs.all_docs()) == [
+            d["tid"] for d in docs
+        ]
 
 
 # ---------------------------------------------------------------------
@@ -477,3 +505,185 @@ class TestFS409ReplicaPlane:
             for f in report.findings if f.rule == "FS409"
         )
         assert store.verify(study, "r1", f1)
+
+
+# ---------------------------------------------------------------------
+# FS410-FS412: the segmented trial store
+# ---------------------------------------------------------------------
+
+
+class TestFS41xSegmentedStore:
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def _seg_queue(self, tmp_path, n_docs=3, seal=False):
+        qdir, trials, docs = _mk_queue(
+            tmp_path, n_docs=n_docs, backend="segment"
+        )
+        segs = trials.jobs.segments
+        if seal:
+            segs.seal_active()
+        return qdir, trials, docs, segs
+
+    def _replayed_tids(self, qdir):
+        ft = FileTrials(qdir)
+        ft.refresh()
+        return sorted(d["tid"] for d in ft._dynamic_trials)
+
+    def test_fs410_torn_active_tail(self, tmp_path):
+        qdir, trials, docs, segs = self._seg_queue(tmp_path)
+        seg_dir = os.path.join(qdir, "segments")
+        active = json.loads(
+            open(os.path.join(seg_dir, "MANIFEST.json"), "rb")
+            .read().split(b"\n#crc32:")[0]
+        )["active"]
+        path = os.path.join(seg_dir, active)
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) - 9)
+        report = fsck_queue(qdir, repair=False)
+        assert report.by_rule().get("FS410") == 1
+        assert not report.clean
+        # dry run touched nothing: the torn tail is still on disk
+        report = fsck_queue(qdir, repair=True)
+        assert report.by_rule().get("FS410") == 1
+        assert report.clean
+        assert fsck_queue(qdir, repair=False).clean
+        # the intact prefix replays; only the torn final record is lost
+        assert self._replayed_tids(qdir) == [d["tid"] for d in docs][:-1]
+
+    def test_fs410_corrupt_record_inside_sealed_segment(self, tmp_path):
+        qdir, trials, docs, segs = self._seg_queue(tmp_path, seal=True)
+        (entry,) = segs.sealed_entries()
+        path = os.path.join(qdir, "segments", entry["name"])
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:  # garble mid-file, same length
+            f.seek(size // 2)
+            f.write(b"\xff\xff\xff\xff")
+        report = fsck_queue(qdir, repair=False)
+        assert "FS410" in report.by_rule()
+        report = fsck_queue(qdir, repair=True)
+        assert report.clean
+        assert fsck_queue(qdir, repair=False).clean
+        # surviving records still replay (the garbled one is dropped)
+        survivors = self._replayed_tids(qdir)
+        assert set(survivors) < set(d["tid"] for d in docs)
+
+    def test_fs411_missing_manifest_rebuilt(self, tmp_path):
+        qdir, trials, docs, segs = self._seg_queue(tmp_path, seal=True)
+        os.unlink(os.path.join(qdir, "segments", "MANIFEST.json"))
+        report = fsck_queue(qdir, repair=False)
+        assert "FS411" in report.by_rule()
+        assert not os.path.exists(
+            os.path.join(qdir, "segments", "MANIFEST.json")
+        )  # dry run rebuilt nothing
+        report = fsck_queue(qdir, repair=True)
+        assert report.clean
+        assert fsck_queue(qdir, repair=False).clean
+        assert self._replayed_tids(qdir) == [d["tid"] for d in docs]
+
+    def test_fs411_missing_sealed_segment_entry_dropped(self, tmp_path):
+        qdir, trials, docs, segs = self._seg_queue(tmp_path, seal=True)
+        (entry,) = segs.sealed_entries()
+        os.unlink(os.path.join(qdir, "segments", entry["name"]))
+        report = fsck_queue(qdir, repair=False)
+        assert "FS411" in report.by_rule()
+        report = fsck_queue(qdir, repair=True)
+        assert report.clean
+        assert fsck_queue(qdir, repair=False).clean
+        assert self._replayed_tids(qdir) == []  # that data is gone
+
+    def test_fs411_short_sealed_segment_repinned(self, tmp_path):
+        qdir, trials, docs, segs = self._seg_queue(tmp_path, seal=True)
+        (entry,) = segs.sealed_entries()
+        path = os.path.join(qdir, "segments", entry["name"])
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) - 9)
+        report = fsck_queue(qdir, repair=False)
+        assert "FS411" in report.by_rule()
+        report = fsck_queue(qdir, repair=True)
+        assert report.clean
+        assert fsck_queue(qdir, repair=False).clean
+        assert self._replayed_tids(qdir) == [d["tid"] for d in docs][:-1]
+
+    def test_fs412_orphan_segment_deleted(self, tmp_path):
+        qdir, trials, docs, segs = self._seg_queue(tmp_path)
+        orphan = os.path.join(qdir, "segments", "seg-00000042.log")
+        with open(orphan, "wb") as f:
+            f.write(b"\nretired data the dead compactor left behind")
+        report = fsck_queue(qdir, repair=False)
+        assert report.by_rule().get("FS412") == 1
+        assert os.path.exists(orphan)  # dry run deleted nothing
+        report = fsck_queue(qdir, repair=True)
+        assert report.clean
+        assert not os.path.exists(orphan)
+        assert fsck_queue(qdir, repair=False).clean
+        assert self._replayed_tids(qdir) == [d["tid"] for d in docs]
+
+    def test_sigkill_mid_segment_append_recovers(self, tmp_path):
+        """A REAL process SIGKILLed inside a segment group commit (the
+        chaos torn-segment site: tail clipped, then the process dies
+        before acking).  fsck finds the torn tail (FS410), the repair
+        keeps the committed prefix, and the unacked batch is simply
+        absent — never half-applied."""
+        import subprocess
+        import sys
+
+        qdir, trials, docs, segs = self._seg_queue(tmp_path, n_docs=2)
+        code = f"""
+import sys
+sys.path.insert(0, {self.REPO!r})
+from hyperopt_tpu.resilience import chaos
+from hyperopt_tpu.parallel.file_trials import FileJobs
+cfg = chaos.ChaosConfig(seed=5, p_torn_segment=1.0)
+with chaos.active(chaos.ChaosMonkey(cfg)):
+    jobs = FileJobs({qdir!r})
+    jobs.insert({{"tid": 99, "state": 0, "misc": {{"tid": 99}}}})
+raise SystemExit("chaos torn-segment site never fired")
+"""
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=60,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == -9, (proc.returncode, proc.stderr)
+        report = fsck_queue(qdir, repair=True)
+        assert "FS410" in report.by_rule()
+        assert report.clean
+        assert fsck_queue(qdir, repair=False).clean
+        # the unacked tid-99 append vanished whole; the acked docs stand
+        assert self._replayed_tids(qdir) == [d["tid"] for d in docs]
+
+    def test_sigkill_mid_compaction_leaves_only_orphans(self, tmp_path):
+        """A compactor SIGKILLed between publishing the compacted
+        manifest and unlinking the retired segments (the chaos
+        compaction-kill window).  The store is already correct — the
+        new lineage is live — and fsck just sweeps the orphans
+        (FS412)."""
+        import subprocess
+        import sys
+
+        qdir, trials, docs, segs = self._seg_queue(tmp_path, seal=True)
+        code = f"""
+import sys
+sys.path.insert(0, {self.REPO!r})
+from hyperopt_tpu.resilience import chaos
+from hyperopt_tpu.parallel.file_trials import FileJobs
+cfg = chaos.ChaosConfig(seed=5, p_compaction_kill=1.0)
+with chaos.active(chaos.ChaosMonkey(cfg)):
+    jobs = FileJobs({qdir!r})
+    jobs.segments.compact()
+raise SystemExit("chaos compaction-kill site never fired")
+"""
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=60,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == -9, (proc.returncode, proc.stderr)
+        # every doc is intact BEFORE any repair: the compacted lineage
+        # was published atomically
+        assert self._replayed_tids(qdir) == [d["tid"] for d in docs]
+        report = fsck_queue(qdir, repair=True)
+        assert "FS412" in report.by_rule()
+        assert report.clean
+        assert fsck_queue(qdir, repair=False).clean
+        assert self._replayed_tids(qdir) == [d["tid"] for d in docs]

@@ -38,7 +38,8 @@ def test_native_lib_builds_and_loads():
 
 
 def test_count_states_matches_python(tmp_path):
-    jobs = FileJobs(str(tmp_path))
+    # the native scanner reads the legacy per-doc layout
+    jobs = FileJobs(str(tmp_path), backend="doc")
     for tid, state in enumerate([0, 0, 2, 2, 2, 1, 4]):
         jobs.insert(make_doc(tid, state))
     res = native.count_states(os.path.join(str(tmp_path), "trials"))
@@ -54,7 +55,7 @@ def test_count_states_matches_python(tmp_path):
 
 
 def test_list_state_sorted(tmp_path):
-    jobs = FileJobs(str(tmp_path))
+    jobs = FileJobs(str(tmp_path), backend="doc")
     for tid, state in [(5, 0), (2, 0), (9, 2), (1, 0)]:
         jobs.insert(make_doc(tid, state))
     tids = native.list_state(os.path.join(str(tmp_path), "trials"), JOB_STATE_NEW)
@@ -88,7 +89,7 @@ def test_try_lock_race(tmp_path):
 
 
 def test_reserve_uses_native_and_agrees(tmp_path):
-    jobs = FileJobs(str(tmp_path))
+    jobs = FileJobs(str(tmp_path), backend="doc")
     for tid in range(5):
         jobs.insert(make_doc(tid, JOB_STATE_NEW))
     seen = set()
@@ -103,7 +104,7 @@ def test_reserve_uses_native_and_agrees(tmp_path):
 
 
 def test_unparsed_doc_falls_back(tmp_path):
-    jobs = FileJobs(str(tmp_path))
+    jobs = FileJobs(str(tmp_path), backend="doc")
     jobs.insert(make_doc(0, JOB_STATE_NEW))
     # hand-write a doc the textual scanner cannot parse (no "state": int)
     weird = os.path.join(str(tmp_path), "trials", "000000000099.json")

@@ -224,6 +224,15 @@ class TestExpositionFormat:
             "hyperopt_store_refresh_total",
             "hyperopt_store_journal_appends_total",
             "hyperopt_store_journal_torn_lines_total",
+            # segmented trial log (new)
+            "hyperopt_store_segment_appends_total",
+            "hyperopt_store_segment_records_total",
+            "hyperopt_store_segment_seals_total",
+            "hyperopt_store_segment_compactions_total",
+            "hyperopt_store_segment_replays_total",
+            "hyperopt_store_segment_replay_records_total",
+            "hyperopt_store_segment_torn_lines_total",
+            "hyperopt_store_segments_pulled_total",
             "hyperopt_store_lease_events_total",
             "hyperopt_store_quarantined_docs_total",
             # slo (new)

@@ -1,0 +1,282 @@
+"""Pull-based sealed-segment replication (PR 16 tentpole, service leg).
+
+A failover target pre-warms a study WITHOUT a shared filesystem root by
+pulling the owner's sealed trial-log segments (fence-checked cut
+points, CRC-verified byte copies, manifest published last).  The gate
+here is the twin campaign: a study served on replica A, cut, mirrored
+into replica B's own root, and continued on B must produce a
+trial-for-trial identical trajectory to a single-replica twin — and
+the rebuilt root must be fsck-clean.
+"""
+
+import os
+import time
+
+import pytest
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.parallel.file_trials import FileTrials
+from hyperopt_tpu.resilience.fsck import fsck_queue
+from hyperopt_tpu.service import OptimizationService
+from hyperopt_tpu.service.replicas import SegmentMirror, StudyLeaseStore
+
+SPACE = {"x": hp.uniform("x", -5, 5)}
+AP = {"n_startup_jobs": 2, "n_EI_candidates": 8}
+
+
+def _seed_study(root, study_id="s", n_trials=4):
+    """A segmented FileTrials study dir with ``n_trials`` inserted docs
+    and a sealed active segment (the graceful cut)."""
+    qdir = os.path.join(root, "studies", study_id)
+    ft = FileTrials(qdir)
+    tids = ft.new_trial_ids(n_trials)
+    ft._insert_trial_docs(
+        [{"tid": t, "state": 0, "misc": {"tid": t}} for t in tids]
+    )
+    ft.jobs.segments.seal_active()
+    return qdir, tids
+
+
+class TestSegmentMirror:
+    def test_pull_is_verified_idempotent_and_replayable(self, tmp_path):
+        src, dst = str(tmp_path / "a"), str(tmp_path / "b")
+        _seed_study(src, n_trials=5)
+        StudyLeaseStore(src).claim("s", "owner-a")
+
+        mirror = SegmentMirror(src, dst)
+        out = mirror.pull_study("s")
+        assert out["ok"] and out["n_pulled"] == 1
+        # idempotent: a second pull re-copies nothing (sealed segments
+        # are immutable; presence-at-size is the skip test)
+        again = mirror.pull_study("s")
+        assert again["ok"] and again["n_pulled"] == 0
+        # the pulled root replays to the same trial set
+        ft = FileTrials(os.path.join(dst, "studies", "s"))
+        ft.refresh()
+        assert sorted(d["tid"] for d in ft._dynamic_trials) == [
+            0, 1, 2, 3, 4,
+        ]
+
+    def test_corrupt_source_segment_is_refused(self, tmp_path):
+        src, dst = str(tmp_path / "a"), str(tmp_path / "b")
+        qdir, _ = _seed_study(src)
+        seg_dir = os.path.join(qdir, "segments")
+        name = next(
+            n for n in sorted(os.listdir(seg_dir)) if n.startswith("seg-")
+        )
+        with open(os.path.join(seg_dir, name), "r+b") as f:
+            f.seek(10)
+            f.write(b"XXXX")
+        out = SegmentMirror(src, dst).pull_study("s")
+        assert not out["ok"]
+        assert "CRC" in out["reason"]
+        # nothing was published: no manifest, so the dst replays empty
+        assert not os.path.exists(
+            os.path.join(dst, "studies", "s", "segments", "MANIFEST.json")
+        )
+
+    def test_fence_move_mid_pull_withholds_manifest(self, tmp_path):
+        src, dst = str(tmp_path / "a"), str(tmp_path / "b")
+        _seed_study(src)
+        real = StudyLeaseStore(src)
+        real.claim("s", "owner-a")
+
+        class MovingFence(StudyLeaseStore):
+            def __init__(self):
+                super().__init__(src)
+                self.calls = 0
+
+            def read_fence(self, study_id):
+                self.calls += 1
+                base = super().read_fence(study_id)
+                return base if self.calls == 1 else base + 1
+
+        mirror = SegmentMirror(src, dst)
+        mirror.leases = MovingFence()
+        out = mirror.pull_study("s")
+        assert not out["ok"] and "fence moved" in out["reason"]
+        # the copied segments are kept (immutable, reusable) but the
+        # manifest is withheld — the dst store sees no study yet
+        dst_segs = os.path.join(dst, "studies", "s", "segments")
+        assert any(
+            n.startswith("seg-") for n in os.listdir(dst_segs)
+        )
+        assert not os.path.exists(
+            os.path.join(dst_segs, "MANIFEST.json")
+        )
+
+    def test_same_root_is_refused(self, tmp_path):
+        with pytest.raises(ValueError):
+            SegmentMirror(str(tmp_path), str(tmp_path))
+
+    def test_pull_all_covers_every_study(self, tmp_path):
+        src, dst = str(tmp_path / "a"), str(tmp_path / "b")
+        _seed_study(src, study_id="s1", n_trials=2)
+        _seed_study(src, study_id="s2", n_trials=3)
+        outs = SegmentMirror(src, dst).pull_all()
+        assert [o["study"] for o in outs] == ["s1", "s2"]
+        assert all(o["ok"] for o in outs)
+
+
+class TestTwinTrajectoryFailover:
+    @pytest.mark.slow
+    def test_failover_target_rebuilds_from_pulled_segments(self, tmp_path):
+        """Two-replica campaign vs single-replica twin.
+
+        Replica A serves 3 trials on its own root; the cut seals A's
+        active segment; the mirror pulls the sealed log + sidecars into
+        replica B's OWN root (no shared filesystem); B adopts the study
+        from its local copy and serves 3 more.  The combined 6-trial
+        trajectory must be trial-for-trial identical to one service
+        running all 6 — and B's rebuilt study dir must be fsck-clean.
+        """
+        objective = lambda x: (x - 1.0) ** 2  # noqa: E731
+
+        def run_trials(svc, study_id, n):
+            out = []
+            for _ in range(n):
+                (t,) = svc.suggest(study_id, n=1)
+                x = t["vals"]["x"]
+                svc.report(study_id, t["tid"], loss=objective(x))
+                out.append((t["tid"], x))
+            return out
+
+        # the twin: one service, all six trials
+        twin = OptimizationService(
+            root=str(tmp_path / "twin"), batch_window=0.001, warmup=False
+        )
+        try:
+            twin.create_study("mig", SPACE, seed=7, algo="tpe",
+                              algo_params=AP)
+            want = run_trials(twin, "mig", 6)
+        finally:
+            twin.close()
+
+        root_a = str(tmp_path / "ra")
+        root_b = str(tmp_path / "rb")
+        s1 = OptimizationService(
+            root=root_a, replica_id="ra", advertise_url="http://a",
+            replica_ttl=30.0, batch_window=0.001, warmup=False,
+        )
+        try:
+            s1.create_study("mig", SPACE, seed=7, algo="tpe",
+                            algo_params=AP)
+            first = run_trials(s1, "mig", 3)
+            # graceful cut: seal the active segment so every record A
+            # wrote is inside the pulled prefix
+            study = s1.registry.get("mig")
+            study.trials.jobs.segments.seal_active()
+            out = SegmentMirror(root_a, root_b).pull_study("mig")
+            assert out["ok"] and out["n_pulled"] >= 1
+        finally:
+            s1.close()
+
+        # replica B starts on ITS OWN root — everything it knows about
+        # the study arrived through the pull
+        s2 = OptimizationService(
+            root=root_b, replica_id="rb", advertise_url="http://b",
+            replica_ttl=30.0, batch_window=0.001, warmup=False,
+        )
+        try:
+            assert "mig" in s2.registry.list()
+            st = s2.study_status("mig")
+            assert st["n_completed"] == 3
+            rest = run_trials(s2, "mig", 3)
+        finally:
+            s2.close()
+
+        got = first + rest
+        assert [tid for tid, _ in got] == [tid for tid, _ in want]
+        for (_, gx), (_, wx) in zip(got, want):
+            assert gx == pytest.approx(wx, abs=0.0)
+
+        # the rebuilt root is structurally sound: full fsck, no findings
+        report = fsck_queue(
+            os.path.join(root_b, "studies", "mig"), repair=False
+        )
+        assert report.clean, report.findings
+
+    def test_reaper_tick_pulls_through_attached_mirror(self, tmp_path):
+        """ReplicaSet wiring: a mirror attached to the replica set is
+        pulled on the reaper cadence, so the local copy tracks the
+        owner's sealed cuts without any explicit pull call."""
+        from hyperopt_tpu.service.replicas import ReplicaSet
+
+        src, dst = str(tmp_path / "a"), str(tmp_path / "b")
+        _seed_study(src, n_trials=3)
+        rs = ReplicaSet(dst, "rb", url="http://b", ttl=0.2)
+        rs.attach_mirror(SegmentMirror(src, dst, ttl=0.2))
+        rs.bind(adopt=lambda sid, reason: False, relinquish=lambda sid: None)
+        rs.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            manifest = os.path.join(
+                dst, "studies", "s", "segments", "MANIFEST.json"
+            )
+            while time.monotonic() < deadline:
+                if os.path.exists(manifest):
+                    break
+                time.sleep(0.05)
+            assert os.path.exists(manifest)
+        finally:
+            rs.close()
+
+
+class TestSharedCompileCacheRefusal:
+    def test_live_sibling_sharing_cache_dir_is_refused(self, tmp_path):
+        from hyperopt_tpu.service.replicas import ReplicaDirectory
+
+        root = str(tmp_path / "root")
+        cache = str(tmp_path / "cache")
+        directory = ReplicaDirectory(root)
+        directory.advertise("other", "http://other",
+                            compile_cache_dir=cache)
+        with pytest.raises(ValueError, match="compile cache dir"):
+            OptimizationService(
+                root=root, replica_id="me", advertise_url="http://me",
+                compile_cache_dir=cache, warmup=False,
+            )
+
+    def test_unsafe_flag_allows_the_shared_dir(self, tmp_path):
+        from hyperopt_tpu.service.replicas import ReplicaDirectory
+
+        root = str(tmp_path / "root")
+        cache = str(tmp_path / "cache")
+        ReplicaDirectory(root).advertise(
+            "other", "http://other", compile_cache_dir=cache
+        )
+        svc = OptimizationService(
+            root=root, replica_id="me", advertise_url="http://me",
+            compile_cache_dir=cache, warmup=False,
+            unsafe_shared_compile_cache=True,
+        )
+        svc.close()
+
+    def test_stale_sibling_record_does_not_refuse(self, tmp_path):
+        """Only a LIVE record blocks: a dead replica's leftover record
+        (or our own, from a restart) must not wedge startup."""
+        import json as _json
+
+        from hyperopt_tpu.service.replicas import ReplicaDirectory
+        from hyperopt_tpu.parallel.file_trials import _write_doc
+
+        root = str(tmp_path / "root")
+        cache = str(tmp_path / "cache")
+        directory = ReplicaDirectory(root)
+        os.makedirs(directory.registry_dir, exist_ok=True)
+        _write_doc(
+            directory.record_path("dead"),
+            {"replica_id": "dead", "url": "http://dead",
+             "heartbeat_at": time.time() - 3600.0, "pid": 0,
+             "compile_cache_dir": os.path.abspath(cache)},
+            fsync_kind="attachment",
+        )
+        svc = OptimizationService(
+            root=root, replica_id="me", advertise_url="http://me",
+            compile_cache_dir=cache, warmup=False,
+        )
+        try:
+            record = directory.lookup("me")
+            assert record["compile_cache_dir"] == os.path.abspath(cache)
+        finally:
+            svc.close()

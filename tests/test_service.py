@@ -1140,28 +1140,33 @@ class TestReadyz:
             assert ready["device"] in ("warm", "fallback")
             assert ready["fsck"]["clean"] is True
 
-    def test_startup_fsck_repairs_torn_doc_then_ready(self, tmp_path):
+    def test_startup_fsck_repairs_torn_segment_then_ready(self, tmp_path):
         root = str(tmp_path / "r")
         svc = OptimizationService(root=root, batch_window=0.001)
         svc.create_study("s", SPACE, seed=1, algo="rand")
         (t,) = svc.suggest("s", idempotency_key="K")
         svc.report("s", t["tid"], loss=3.0, idempotency_key="R")
         svc.close()
-        # tear the doc on disk (latent corruption a restart discovers)
-        doc_file = os.path.join(
-            root, "studies", "s", "trials", f"{t['tid']:012d}.json"
+        # tear the active segment's tail (latent corruption a restart
+        # discovers): clip mid-record so the last append fails its CRC
+        seg_dir = os.path.join(root, "studies", "s", "segments")
+        manifest = json.loads(
+            open(os.path.join(seg_dir, "MANIFEST.json"), "rb")
+            .read().split(b"\n#crc32:")[0]
         )
-        with open(doc_file, "r+b") as f:
-            f.truncate(os.path.getsize(doc_file) // 2)
+        seg_file = os.path.join(seg_dir, manifest["active"])
+        with open(seg_file, "r+b") as f:
+            f.truncate(os.path.getsize(seg_file) - 9)
         svc2 = OptimizationService(root=root, batch_window=0.001)
         try:
             ready = svc2.readiness()
             assert ready["ready"] is True
-            assert ready["fsck"]["by_rule"].get("FS401") == 1
-            # the doc came back from the journal, loss included
+            assert ready["fsck"]["by_rule"].get("FS410") == 1
+            # the torn record was the report append: the trial survives
+            # (insert record intact); only the unacknowledged-by-crash
+            # tail is dropped, exactly torn-write semantics
             st = svc2.study_status("s")
-            assert st["n_completed"] == 1
-            assert st["best"]["loss"] == 3.0
+            assert st["n_trials"] == 1
         finally:
             svc2.close()
 

@@ -464,19 +464,27 @@ class TestServiceIntegration:
         try:
             self._drive(svc, n=4)
             s = svc.store_stats.summary()
-            # one insert + one result write per trial
-            assert s["doc_writes"] == 8
+            # segmented default backend: one segment append per
+            # trial-state transition (4 inserts + 4 result writes),
+            # one record each on this unbatched path — and NO per-doc
+            # writes at all
+            assert s["doc_writes"] == 0
+            assert s["segment_appends"] == 8
+            assert s["segment_records"] == 8
             # one journal append per keyed mutation (4 suggests +
             # 4 reports; the create above was unkeyed)
             assert s["journal_appends"] == 8
             assert s["fsyncs"]["journal"] == 8
-            # the serve hot path adds ZERO directory scans: only the
-            # study-create refresh scanned
-            assert s["scans"] == 1
+            # ZERO O(N) directory scans anywhere: the study-create
+            # refresh replays the (empty) segment tail, the serve hot
+            # path runs on the materialized view
+            assert s["scans"] == 0
             assert s["refresh_local"] == 8
             assert s["refresh_full"] == 1
-            # every fsync kind accounted
-            assert s["fsyncs"]["doc"] == 8
+            # every fsync kind accounted: no doc fsyncs; one manifest
+            # publish at create + one fsync per segment append
+            assert s["fsyncs"].get("doc", 0) == 0
+            assert s["fsyncs"]["segment"] == 9
             assert s["fsyncs"]["counter"] == 4
             # config + one seed cursor per suggest
             assert s["fsyncs"]["attachment"] == 5
