@@ -439,10 +439,16 @@ class SegmentStore:
             for doc in docs:
                 self._apply(copy.deepcopy(doc))
             # our own appended bytes are already in the view: advance
-            # the cursor so the next refresh does not replay them
-            self._offsets[active] = max(
-                self._offsets.get(active, 0), end
-            )
+            # the cursor so the next refresh does not replay them — but
+            # ONLY when our write is contiguous with it.  Under
+            # O_APPEND another process's records may have landed in
+            # [cursor, end - nbytes) between our refresh above and our
+            # write; jumping the cursor to `end` would skip those bytes
+            # forever.  Leaving the cursor put lets the next refresh
+            # replay the gap; re-replaying our own records is harmless
+            # (latest-wins per tid).
+            if self._offsets.get(active, 0) == end - nbytes:
+                self._offsets[active] = end
             self._maybe_seal_locked()
             if self.auto_compact and self._compaction_due_locked():
                 self._compact_locked()
@@ -473,7 +479,18 @@ class SegmentStore:
             except FileExistsError:
                 try:
                     if time.time() - os.path.getmtime(lock) > 30.0:
-                        os.unlink(lock)
+                        # break the stale lock by renaming it to a
+                        # private name first: only ONE breaker wins the
+                        # rename, so two processes that both judged the
+                        # lock stale cannot end up holding the mutex
+                        # concurrently (unlinking the shared path
+                        # directly could remove a fresh lock another
+                        # breaker just re-created)
+                        stale = "%s.stale-%d-%d" % (
+                            lock, os.getpid(), time.monotonic_ns()
+                        )
+                        os.rename(lock, stale)  # durability: exempt(lock break: the lock file carries no data; the rename IS the mutual exclusion)
+                        os.unlink(stale)
                         continue
                 except OSError:
                     continue

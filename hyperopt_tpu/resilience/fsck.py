@@ -475,18 +475,64 @@ def _fsck_segments(qdir, repair, report: FsckReport) -> dict:
     for path in seg_paths:
         if os.path.basename(path) in referenced:
             continue
+        # an orphan can hold ACKED records that exist nowhere else: an
+        # appender whose post-append manifest check ran before the
+        # compactor's swap left fsync'd records in the old active, and
+        # a compactor killed after the swap but before re-homing the
+        # stragglers never copied them forward.  Fold the orphan
+        # latest-wins per tid and re-home anything the replayed view
+        # does not already supersede before deleting the file.
+        orphan_latest = {}
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            raw = b""
+        if raw:
+            records, _consumed, _torn, _pending = parse(
+                raw, object_hook=_json_object_hook
+            )
+            for rec in records:
+                orphan_latest[int(rec["tid"])] = rec
+        stragglers = []
+        for tid in sorted(orphan_latest):
+            rec = orphan_latest[tid]
+            have = view.get(tid)
+            if have is None or (
+                rec != have
+                and int(rec.get("state", 0)) >= int(have.get("state", 0))
+            ):
+                stragglers.append(rec)
         fixed = False
-        if repair:
+        action = ""
+        if repair and (active or not stragglers):
             try:
+                if stragglers:
+                    from .. import journal_io
+                    from ..parallel.file_trials import _json_default
+
+                    # durability: exempt(offline repair: fsck runs single-writer against a stopped queue)
+                    journal_io.append_records(
+                        os.path.join(sdir, active), stragglers,
+                        default=_json_default, fsync_kind="segment",
+                    )
+                    for rec in stragglers:
+                        view[int(rec["tid"])] = rec
                 os.unlink(path)
                 fixed = True
+                action = (
+                    f"re-homed {len(stragglers)} acked record(s) to "
+                    f"{active}; deleted"
+                ) if stragglers else "deleted"
             except OSError:
                 pass
-        report.add(
-            "FS412", path,
-            "orphaned segment file (compactor killed before retiring it)",
-            repaired=fixed, action="deleted" if fixed else "",
-        )
+        msg = "orphaned segment file (compactor killed before retiring it)"
+        if stragglers:
+            msg += (
+                f"; holds {len(stragglers)} acked record(s) absent from "
+                "the replayed view"
+            )
+        report.add("FS412", path, msg, repaired=fixed, action=action)
 
     if repair and manifest_dirty:
         manifest = dict(manifest)

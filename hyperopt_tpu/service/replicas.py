@@ -577,6 +577,16 @@ class SegmentMirror:
     next cut.  ``pull_study`` raises nothing; it returns a summary dict
     with ``ok``/``reason`` so callers can poll it from maintenance
     loops.
+
+    Pulling STOPS once the destination takes over: after a failover the
+    claim lives in the destination root's lease plane (the source fence
+    never moves again — the owner that would bump it is dead), so a
+    pull that kept trusting the source snapshot would overwrite the
+    now-live local manifest and sidecars every tick, re-issuing trial
+    ids and losing post-takeover records.  ``pull_study`` therefore
+    refuses any study that is live-owned at ``dst_root``, and
+    ``ReplicaSet`` additionally passes its own ownership set to
+    ``pull_all``.
     """
 
     def __init__(self, src_root, dst_root,
@@ -589,6 +599,7 @@ class SegmentMirror:
                 "into itself would republish its own manifest"
             )
         self.leases = StudyLeaseStore(self.src_root, ttl=ttl)
+        self.dst_leases = StudyLeaseStore(self.dst_root, ttl=ttl)
 
     def _study_dirs(self, study_id):
         src = os.path.join(self.src_root, "studies", str(study_id))
@@ -607,6 +618,19 @@ class SegmentMirror:
         study_id = str(study_id)
         out = {"study": study_id, "ok": False, "n_pulled": 0,
                "nbytes": 0}
+        dst_owner, _dst_fence, dst_live = self.dst_leases.owner_of(
+            study_id
+        )
+        if dst_live:
+            # the study was taken over here (or by a sibling serving
+            # this root): the local copy is now the live truth and the
+            # source snapshot is history — overwriting the manifest,
+            # journal, seed cursor, and id counter would corrupt it
+            out["reason"] = (
+                f"study is live-owned at the destination by "
+                f"{dst_owner!r}; pull skipped"
+            )
+            return out
         src_q, dst_q = self._study_dirs(study_id)
         manifest_path = os.path.join(
             src_q, "segments", sstore.MANIFEST_NAME
@@ -675,8 +699,14 @@ class SegmentMirror:
             except OSError:
                 continue  # absent sidecars are normal (fresh study)
             dst_path = os.path.join(dst_q, rel)
+            try:
+                with open(dst_path, "rb") as f:
+                    if f.read() == raw:
+                        continue  # byte-identical: nothing to publish
+            except OSError:
+                pass
             os.makedirs(os.path.dirname(dst_path), exist_ok=True)
-            _atomic_write(dst_path, raw, fsync_kind="attachment")
+            _atomic_write(dst_path, raw, fsync_kind="attachment")  # durability: exempt(single-writer: one mirror pulls into its own root; the read is only an identical-bytes skip)
             nbytes += len(raw)
         fence_after = self.leases.read_fence(study_id)
         if fence_after != fence_before:
@@ -685,11 +715,11 @@ class SegmentMirror:
                 "segments kept, manifest withheld"
             )
             return out
-        _write_doc(
-            os.path.join(dst_q, "segments", sstore.MANIFEST_NAME),
-            manifest,
-            fsync_kind="segment",
+        dst_manifest_path = os.path.join(
+            dst_q, "segments", sstore.MANIFEST_NAME
         )
+        if _read_doc(dst_manifest_path, quarantine=False) != manifest:
+            _write_doc(dst_manifest_path, manifest, fsync_kind="segment")
         stats = _segment_stats()
         if stats is not None:
             stats.record_segment_pull(n_pulled, nbytes)
@@ -703,10 +733,14 @@ class SegmentMirror:
         )
         return out
 
-    def pull_all(self) -> list:
+    def pull_all(self, skip=None) -> list:
         """Pull every study visible at the source; returns the per-study
         summaries (mirroring is advisory — failures surface as
-        ``ok=False`` reasons, never exceptions)."""
+        ``ok=False`` reasons, never exceptions).  ``skip`` is an
+        optional ``skip(study_id) -> bool`` predicate — the replica set
+        passes its own ownership check so studies it serves are never
+        pulled over (``pull_study`` independently refuses any study
+        live-owned at the destination root)."""
         studies_dir = os.path.join(self.src_root, "studies")
         try:
             names = sorted(os.listdir(studies_dir))
@@ -715,6 +749,8 @@ class SegmentMirror:
         out = []
         for study_id in names:
             if not os.path.isdir(os.path.join(studies_dir, study_id)):
+                continue
+            if skip is not None and skip(study_id):
                 continue
             try:
                 out.append(self.pull_study(study_id))
@@ -1096,7 +1132,9 @@ class ReplicaSet:
         while not self._stop.wait(interval):
             if self.mirror is not None:
                 try:
-                    self.mirror.pull_all()
+                    # never pull over a study this replica serves: after
+                    # a takeover the source snapshot is stale history
+                    self.mirror.pull_all(skip=self.owns)
                 except Exception:
                     logger.exception(
                         "segment mirror pull failed; continuing"

@@ -618,6 +618,61 @@ class TestFS41xSegmentedStore:
         assert fsck_queue(qdir, repair=False).clean
         assert self._replayed_tids(qdir) == [d["tid"] for d in docs]
 
+    def test_fs412_orphan_with_acked_records_rehomed(self, tmp_path):
+        """The compaction chaos window can strand ACKED records in the
+        orphaned old active: an appender whose post-append manifest
+        check ran before the swap left fsync'd records there, and the
+        compactor died before re-homing them.  The repair must replay
+        them into the active segment, never silently delete them."""
+        from hyperopt_tpu import journal_io
+        from hyperopt_tpu.parallel.file_trials import _json_default
+
+        qdir, trials, docs, segs = self._seg_queue(tmp_path)
+        straggler = {
+            "tid": 77, "state": JOB_STATE_NEW, "misc": {"tid": 77},
+        }
+        orphan = os.path.join(qdir, "segments", "seg-00000042.log")
+        journal_io.append_records(
+            orphan, [straggler], default=_json_default,
+            fsync_kind="segment",
+        )
+        report = fsck_queue(qdir, repair=False)
+        assert report.by_rule().get("FS412") == 1
+        assert os.path.exists(orphan)  # dry run touched nothing
+        report = fsck_queue(qdir, repair=True)
+        assert report.clean
+        assert not os.path.exists(orphan)
+        assert fsck_queue(qdir, repair=False).clean
+        # the acked record survived the sweep
+        assert self._replayed_tids(qdir) == (
+            [d["tid"] for d in docs] + [77]
+        )
+
+    def test_fs412_stale_orphan_copy_not_rehomed(self, tmp_path):
+        """An orphan can also hold a SUPERSEDED copy of a doc (the
+        pre-compaction history).  Re-homing it would regress the trial
+        state under latest-wins replay — only records the replayed view
+        does not supersede move."""
+        from hyperopt_tpu import journal_io
+        from hyperopt_tpu.parallel.file_trials import _json_default
+
+        qdir, trials, docs, segs = self._seg_queue(tmp_path)
+        done = dict(docs[0])
+        done["state"] = JOB_STATE_DONE
+        segs.append(done)
+        orphan = os.path.join(qdir, "segments", "seg-00000042.log")
+        journal_io.append_records(
+            orphan, [docs[0]], default=_json_default,
+            fsync_kind="segment",
+        )  # the stale NEW-state copy
+        report = fsck_queue(qdir, repair=True)
+        assert report.clean
+        assert not os.path.exists(orphan)
+        ft = FileTrials(qdir)
+        ft.refresh()
+        states = {d["tid"]: d["state"] for d in ft._dynamic_trials}
+        assert states[docs[0]["tid"]] == JOB_STATE_DONE
+
     def test_sigkill_mid_segment_append_recovers(self, tmp_path):
         """A REAL process SIGKILLed inside a segment group commit (the
         chaos torn-segment site: tail clipped, then the process dies

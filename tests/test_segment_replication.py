@@ -117,6 +117,67 @@ class TestSegmentMirror:
         assert [o["study"] for o in outs] == ["s1", "s2"]
         assert all(o["ok"] for o in outs)
 
+    def test_pull_refused_once_destination_owns_the_study(self, tmp_path):
+        """After a takeover the claim lives in the DESTINATION's lease
+        plane — the dead owner's source fence never moves again, so a
+        fence check against the source cannot protect the live local
+        state.  pull_study must refuse outright, and the reaper-tick
+        pull_all honors the replica set's ownership skip."""
+        src, dst = str(tmp_path / "a"), str(tmp_path / "b")
+        _seed_study(src, n_trials=3)
+        mirror = SegmentMirror(src, dst)
+        assert mirror.pull_study("s")["ok"]  # pre-takeover pull works
+
+        # the takeover: the destination claims the study in its OWN
+        # root and keeps serving — its log grows past the snapshot
+        StudyLeaseStore(dst).claim("s", "rb")
+        ft = FileTrials(os.path.join(dst, "studies", "s"))
+        ft.refresh()
+        (tid,) = ft.new_trial_ids(1)
+        ft._insert_trial_docs(
+            [{"tid": tid, "state": 0, "misc": {"tid": tid}}]
+        )
+
+        out = mirror.pull_study("s")
+        assert not out["ok"] and "live-owned" in out["reason"]
+        # the post-takeover record survived: no stale overwrite of the
+        # manifest / sidecars, and no re-issued trial id
+        ft2 = FileTrials(os.path.join(dst, "studies", "s"))
+        ft2.refresh()
+        assert tid in [d["tid"] for d in ft2._dynamic_trials]
+        assert ft2.new_trial_ids(1)[0] == tid + 1
+        # the ownership predicate short-circuits pull_all entirely
+        assert mirror.pull_all(skip=lambda sid: sid == "s") == []
+
+    def test_repeat_pull_does_not_churn_identical_state(self, tmp_path):
+        """A no-change re-pull must not republish the manifest or
+        sidecars: every atomic replace on the destination races a
+        concurrently-starting reader there, so byte-identical copies
+        stay untouched."""
+        src, dst = str(tmp_path / "a"), str(tmp_path / "b")
+        _seed_study(src, n_trials=3)
+        mirror = SegmentMirror(src, dst)
+        assert mirror.pull_study("s")["ok"]
+        manifest = os.path.join(
+            dst, "studies", "s", "segments", "MANIFEST.json"
+        )
+        counter = os.path.join(dst, "studies", "s", "ids.counter")
+        sig = (
+            os.stat(manifest).st_mtime_ns,
+            os.stat(manifest).st_ino,
+            os.stat(counter).st_mtime_ns,
+            os.stat(counter).st_ino,
+        )
+        again = mirror.pull_study("s")
+        assert again["ok"] and again["n_pulled"] == 0
+        assert again["nbytes"] == 0
+        assert sig == (
+            os.stat(manifest).st_mtime_ns,
+            os.stat(manifest).st_ino,
+            os.stat(counter).st_mtime_ns,
+            os.stat(counter).st_ino,
+        )
+
 
 class TestTwinTrajectoryFailover:
     @pytest.mark.slow

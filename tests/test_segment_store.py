@@ -1,0 +1,119 @@
+"""SegmentStore cross-process safety regressions.
+
+The segmented trial log promises multi-process-appender safety on a
+shared filesystem (module docstring of
+``hyperopt_tpu.parallel.segment_store``).  These tests pin the two
+subtle pieces of that promise:
+
+- an appender must never advance its replay cursor over bytes another
+  process's ``O_APPEND`` write landed between its refresh and its own
+  write (the gap would be skipped until the next compaction epoch);
+- breaking a stale ``.seal.lock`` must not let two sealers run
+  concurrently (the break goes through a rename only one process can
+  win).
+"""
+
+import os
+import time
+
+from hyperopt_tpu.parallel import segment_store as sstore
+
+
+def _doc(tid, state=0):
+    return {"tid": tid, "state": state, "misc": {"tid": tid}}
+
+
+class TestInterleavedAppenders:
+    def test_append_does_not_skip_interleaved_appender_bytes(
+        self, tmp_path, monkeypatch
+    ):
+        """Process A refreshes, process B appends, process A appends:
+        B's record sits in [A's cursor, A's write start) and A must
+        replay it on its next refresh instead of jumping its cursor
+        past it forever."""
+        a = sstore.SegmentStore(str(tmp_path), auto_compact=False)
+        b = sstore.SegmentStore(str(tmp_path), auto_compact=False)
+        a.append(_doc(0))
+        b.refresh()
+
+        real = sstore.journal_io.append_records
+        fired = []
+
+        def interleave(path, payloads, **kw):
+            # B's append lands first, below A's — exactly the window
+            # between A's in-lock refresh and A's own O_APPEND write
+            if not fired:
+                fired.append(True)
+                b.append(_doc(1))
+            return real(path, payloads, **kw)
+
+        monkeypatch.setattr(
+            sstore.journal_io, "append_records", interleave
+        )
+        a.append(_doc(2))
+        monkeypatch.setattr(sstore.journal_io, "append_records", real)
+
+        assert sorted(d["tid"] for d in a.all_docs()) == [0, 1, 2]
+        assert a.count_states()[0] == 3
+        # B (whose own cursor is contiguous) sees everything too
+        assert sorted(d["tid"] for d in b.all_docs()) == [0, 1, 2]
+
+    def test_contiguous_append_still_advances_the_cursor(self, tmp_path):
+        """The common single-appender case keeps its O(0) refresh: the
+        appender's own bytes are not re-read on the next refresh."""
+        store = sstore.SegmentStore(str(tmp_path), auto_compact=False)
+        store.append(_doc(0))
+        active = store._manifest["active"]
+        size = os.path.getsize(store.segment_path(active))
+        assert store._offsets[active] == size
+        assert store.refresh() == []  # nothing unseen
+
+
+class TestStaleSealLock:
+    def test_stale_lock_is_broken_and_seal_proceeds(self, tmp_path):
+        store = sstore.SegmentStore(str(tmp_path), auto_compact=False)
+        store.append(_doc(0))
+        lock = os.path.join(store.dir, ".seal.lock")
+        with open(lock, "w"):
+            pass
+        old = time.time() - 120.0
+        os.utime(lock, (old, old))
+        store.seal_active()
+        assert store.sealed_entries()  # the seal landed
+        # no residue: neither the shared lock nor the private rename
+        # target survives the break
+        leftovers = [
+            n for n in os.listdir(store.dir)
+            if n == ".seal.lock" or ".stale-" in n
+        ]
+        assert leftovers == []
+
+    def test_losing_breaker_retries_instead_of_unlinking(
+        self, tmp_path, monkeypatch
+    ):
+        """Two processes judge the lock stale; the rename loser must
+        NOT remove the shared path (which the winner may have just
+        re-created as its own live lock)."""
+        store = sstore.SegmentStore(str(tmp_path), auto_compact=False)
+        store.append(_doc(0))
+        lock = os.path.join(store.dir, ".seal.lock")
+        with open(lock, "w"):
+            pass
+        old = time.time() - 120.0
+        os.utime(lock, (old, old))
+
+        real_rename = os.rename
+
+        def lose_the_race(src, dst, *a, **kw):
+            if src == lock and ".stale-" in str(dst):
+                # the other breaker renamed the stale lock first and
+                # immediately re-acquired: simulate by freshening the
+                # shared path (their new live lock)
+                os.utime(lock, None)
+                raise FileNotFoundError(src)
+            return real_rename(src, dst, *a, **kw)
+
+        monkeypatch.setattr(os, "rename", lose_the_race)
+        assert store._seal_lock_acquire(timeout=0.2) is None
+        # the winner's fresh lock is untouched
+        assert os.path.exists(lock)
