@@ -1,7 +1,7 @@
-"""hyperopt_tpu.analysis — four-pass static analyzer.
+"""hyperopt_tpu.analysis — five-pass static analyzer + protocol model.
 
 One structured-diagnostic model (rule id, severity, location, fix hint;
-:mod:`.diagnostics`) shared by four passes:
+:mod:`.diagnostics`) shared by five passes:
 
 - :func:`lint_space` (:mod:`.space_lint`) — walks the pyll graph of any
   ``hp.*`` space: duplicate/shadowed labels, inverted bounds,
@@ -26,6 +26,21 @@ One structured-diagnostic model (rule id, severity, location, fix hint;
   every durable-write site in the package: truncate-then-write of live
   paths, atomic replaces without fsync, unframed or multi-write journal
   appends, dangling tmp files, unlocked read-modify-write.
+- :func:`lint_protocol` (:mod:`.protocol_lint`) — the SG7xx segment-
+  protocol ordering disciplines over every module declaring a
+  ``protocol:`` site annotation (auto-discovered like the race pass):
+  fence-validated-before-durable-commit, manifest-published-last,
+  cursor-advance-only-on-contiguity, rename-before-unlink for shared
+  lock breaks, ownership-check-before-pull.  Its Tier B companion
+  (:mod:`.protocol_model`) is an explicit-state model checker that
+  exhaustively explores appender/sealer/compactor/mirror/takeover
+  interleavings with crash injection and reports violations as SG706
+  diagnostics carrying the violating schedule.
+
+Both CI entry points (``scripts/lint.py`` and ``python -m
+hyperopt_tpu.analysis self``) run the SAME :func:`run_self_lint`
+section list — one package walk, one annotation-discovery read, one
+pass ordering — so the gate can never diverge between them.
 
 CLI: ``python -m hyperopt_tpu.analysis <target>`` (see ``--help``);
 CI entry point: ``scripts/lint.py`` (hard gate; ``--no-gate`` to
@@ -57,6 +72,8 @@ from .program_lint import (
     lint_programs,
     lint_traced_program,
 )
+from .protocol_lint import discover_protocol_files, lint_protocol
+from .protocol_model import model_check_diagnostics
 from .race_lint import lint_file, lint_source, lock_order_graph
 from .space_lint import lint_space
 
@@ -68,6 +85,7 @@ __all__ = [
     "RecompilationAuditor",
     "audit_tpe_run",
     "diagnostics_json",
+    "discover_protocol_files",
     "discover_race_files",
     "format_report",
     "has_errors",
@@ -78,13 +96,16 @@ __all__ = [
     "lint_partition_program",
     "lint_pin_sites",
     "lint_programs",
+    "lint_protocol",
     "lint_races",
     "lint_repo",
     "lint_source",
     "lint_space",
     "lint_traced_program",
     "lock_order_graph",
+    "model_check_diagnostics",
     "package_files",
+    "run_self_lint",
     "sort_diagnostics",
 ]
 
@@ -178,25 +199,80 @@ def lint_races(paths=None, suppress=()):
     return out
 
 
-def lint_repo(static_only: bool = True, suppress=(), paths=None,
-              race_paths=None):
-    """Self-lint: race pass over every lock-bearing module + durability
-    pass over every write site + program pass (donation + partition pin
-    sites + dispatch-container call sites).  ``static_only=False``
-    additionally traces the live suggest program — including the
-    partition audit on the virtual mesh (imports jax, runs a small CPU
-    probe).  The package is walked and race-filtered ONCE; callers that
-    already discovered (for reporting counts) pass ``paths`` /
-    ``race_paths`` so nothing is re-read."""
+def run_self_lint(suppress=(), static_only: bool = True,
+                  deep: bool = False, paths=None, race_paths=None,
+                  protocol_paths=None):
+    """THE self-lint both CI entry points share — one package walk,
+    one discovery read, one pass ordering (``scripts/lint.py`` and
+    ``python -m hyperopt_tpu.analysis self`` are thin wrappers over
+    this, so the gate can never diverge between them).  Returns
+    ``[(key, header, diagnostics, seconds)]`` sections, in run order:
+
+    1. race pass over every auto-discovered lock-bearing module;
+    2. durability pass over every package module;
+    3. program pass (static; ``static_only=False`` adds the live
+       jaxpr trace + partition audit — imports jax);
+    4. protocol pass (SG7xx) over every auto-discovered
+       ``protocol:``-annotated module;
+    5. protocol model check (Tier B, SG706): every scenario with
+       crash budget 1; ``deep=True`` runs the full sweep (budget 2).
+    """
+    import time as _time
+
     if paths is None:
         paths = package_files()
     if race_paths is None:
         race_paths = discover_race_files(paths=paths)
-    out = list(lint_races(race_paths, suppress=suppress))
-    out.extend(lint_durability(paths, suppress=suppress))
-    out.extend(lint_programs(static_only=static_only, suppress=suppress,
-                             paths=paths))
-    return out
+    if protocol_paths is None:
+        protocol_paths = discover_protocol_files(paths=paths)
+
+    sections = []
+
+    def run(key, header, fn):
+        t0 = _time.perf_counter()
+        ds = fn()
+        sections.append((key, header, ds, _time.perf_counter() - t0))
+
+    run("race",
+        f"== race pass ({len(race_paths)} lock-bearing modules, "
+        f"guarded-by/lock-order/lock-graph)",
+        lambda: lint_races(race_paths, suppress=suppress))
+    run("durability",
+        f"== durability pass ({len(paths)} modules, "
+        f"write-site discipline)",
+        lambda: lint_durability(paths, suppress=suppress))
+    run("program",
+        "== program pass (donation + pin sites + dispatch containers"
+        + (", static)" if static_only else " + live trace)"),
+        lambda: lint_programs(static_only=static_only,
+                              suppress=suppress, paths=paths))
+    run("protocol",
+        f"== protocol pass ({len(protocol_paths)} protocol modules, "
+        f"SG7xx ordering disciplines)",
+        lambda: lint_protocol(protocol_paths, suppress=suppress))
+    run("model",
+        "== protocol model ("
+        + ("full sweep, crash budget 2" if deep
+           else "small scope, crash budget 1") + ")",
+        lambda: model_check_diagnostics(deep=deep, suppress=suppress))
+    return sections
+
+
+def lint_repo(static_only: bool = True, suppress=(), paths=None,
+              race_paths=None):
+    """Self-lint: the flat diagnostic list of every
+    :func:`run_self_lint` section — race + durability + program +
+    protocol passes plus the small-scope protocol model check.
+    ``static_only=False`` additionally traces the live suggest program
+    — including the partition audit on the virtual mesh (imports jax,
+    runs a small CPU probe).  The package is walked and discovery-
+    filtered ONCE; callers that already discovered (for reporting
+    counts) pass ``paths`` / ``race_paths`` so nothing is re-read."""
+    sections = run_self_lint(
+        suppress=suppress, static_only=static_only, paths=paths,
+        race_paths=race_paths,
+    )
+    return [d for _, _, ds, _ in sections for d in ds]
 
 
 def diagnostics_json(diags):

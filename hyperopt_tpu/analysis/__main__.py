@@ -15,8 +15,14 @@ Targets:
   (default: every auto-discovered lock-bearing module of the package).
 - ``durability [file.py ...]`` — crash-consistency check of every
   durable-write site (default: every package module).
-- ``self`` — the static tier scripts/lint.py gates CI on: race +
-  durability passes over the whole package + static program checks.
+- ``protocol [file.py ...]`` — the SG7xx segment-protocol pass over
+  every ``protocol:``-annotated module (default: auto-discovered)
+  plus the explicit-state protocol model check (``--deep`` runs the
+  full interleaving sweep, crash budget 2).
+- ``self`` — the tier scripts/lint.py gates CI on: race + durability
+  + static program + protocol passes over the whole package plus the
+  small-scope protocol model check (shared run_self_lint sections, so
+  this can never diverge from scripts/lint.py).
 - ``all`` — everything: ``self`` plus the live jaxpr trace and the
   partition audit on the virtual mesh (imports jax).
 - a bare ``foo.py`` / ``pkg.module`` argument — inferred: ``.py`` file
@@ -43,10 +49,12 @@ from . import (
     import_module_target,
     lint_durability,
     lint_programs,
+    lint_protocol,
     lint_races,
-    lint_repo,
     lint_space,
     looks_like_space,
+    model_check_diagnostics,
+    run_self_lint,
     sort_diagnostics,
 )
 from .diagnostics import Severity
@@ -88,6 +96,9 @@ def main(argv=None) -> int:
                          "pass; default N=200)")
     ap.add_argument("--static-only", action="store_true",
                     help="program pass: skip the live jaxpr trace")
+    ap.add_argument("--deep", action="store_true",
+                    help="protocol model: full interleaving sweep "
+                         "(crash budget 2) instead of the small scope")
     ap.add_argument("--suppress", default="",
                     help="comma-separated rule ids to suppress")
     ap.add_argument("--json", action="store_true", dest="as_json",
@@ -135,17 +146,25 @@ def main(argv=None) -> int:
     elif cmd == "durability":
         diags = lint_durability(rest or None, suppress=suppress)
         report(diags, "== durability_lint")
-    elif cmd in ("self", "all"):
-        # `self` = the static tier CI gates on; `all` additionally
-        # traces the live program (jaxpr + partition audit on the
-        # virtual mesh) unless --static-only
-        static_only = cmd == "self" or args.static_only
-        diags = lint_repo(static_only=static_only, suppress=suppress)
-        report(
-            diags,
-            "== self-lint (race + durability + program"
-            + (", static)" if static_only else " + live trace)"),
+    elif cmd == "protocol":
+        diags = lint_protocol(rest or None, suppress=suppress)
+        diags.extend(
+            model_check_diagnostics(deep=args.deep, suppress=suppress)
         )
+        report(diags, "== protocol_lint (SG7xx + model check)")
+    elif cmd in ("self", "all"):
+        # `self` = the tier CI gates on; `all` additionally traces the
+        # live program (jaxpr + partition audit on the virtual mesh)
+        # unless --static-only.  Both run the SAME run_self_lint
+        # sections scripts/lint.py runs.
+        static_only = cmd == "self" or args.static_only
+        for _key, header, ds, _secs in run_self_lint(
+            suppress=suppress, static_only=static_only, deep=args.deep,
+        ):
+            diags.extend(ds)
+            report(ds, header)
+        if not args.as_json:
+            print(_summary(diags))
     else:
         # inference: .py file -> race + durability; module -> space
         if cmd.endswith(".py") and os.path.exists(cmd):

@@ -7,8 +7,11 @@ reporter, one suppression mechanism, and one CI gate serve all three.
 
 Rule ids are namespaced by pass: ``SP1xx`` space rules, ``PL2xx``
 program rules (including the PL206–PL208 partition-safety rules),
-``RL3xx`` race rules, ``DL4xx`` durability rules.  The catalog below is
-the single source of truth; ``docs/static_analysis.md`` renders it.
+``RL3xx`` race rules, ``DL4xx`` durability rules, ``SG7xx`` segment-
+protocol rules.  The catalog below is the single source of truth;
+``docs/static_analysis.md`` renders it.  (``FS4xx`` ids are fsck
+*repair* rules, not analyzer rules — they live in
+:mod:`hyperopt_tpu.resilience.fsck` and ``docs/resilience.md``.)
 
 Suppression:
 
@@ -265,6 +268,66 @@ RULES = {
             "function with no lock and no O_APPEND: two concurrent "
             "writers interleave read-modify-write and one update is "
             "silently lost.",
+        ),
+        # -- protocol_lint -----------------------------------------------
+        Rule(
+            "SG701", Severity.ERROR, "unvalidated-durable-commit",
+            "A replication-write site publishes its commit point (the "
+            "manifest) without a fence validation immediately before "
+            "it, or an orphan sweep unlinks a segment with no "
+            "straggler re-home preceding the unlink: a stale mirror "
+            "can commit over a takeover, or acked records that exist "
+            "nowhere else are destroyed.",
+        ),
+        Rule(
+            "SG702", Severity.ERROR, "write-after-manifest-publish",
+            "A durable write follows the manifest publish in a "
+            "replication-write site: the manifest is the commit point, "
+            "so anything written after it is either unreferenced "
+            "(wasted) or — for sidecars — can clobber post-takeover "
+            "state the already-published manifest now governs.",
+        ),
+        Rule(
+            "SG703", Severity.ERROR, "non-contiguous-cursor-advance",
+            "A replay cursor/offset is advanced past bytes the view "
+            "never applied: a max(cursor, end)-style jump, or an "
+            "unguarded advance in a cursor-advance site (no "
+            "contiguity equality check dominating the assignment).  "
+            "Under O_APPEND another process's records can land in the "
+            "gap and be skipped forever.",
+        ),
+        Rule(
+            "SG704", Severity.ERROR, "shared-lock-unlink",
+            "A stale shared lock file is broken by unlinking the "
+            "shared path directly (inside the FileExistsError "
+            "acquire path): two breakers that both judged the lock "
+            "stale can each unlink-and-recreate, ending up inside the "
+            "critical section concurrently.  Rename the lock to a "
+            "private name first — only one breaker wins the rename.",
+        ),
+        Rule(
+            "SG705", Severity.ERROR, "pull-without-ownership-check",
+            "A replication-write site performs a durable write before "
+            "checking destination ownership: a mirror tick racing a "
+            "local takeover overwrites the live manifest, response "
+            "journal, seed cursor, or id counter with the stale "
+            "source snapshot.",
+        ),
+        Rule(
+            "SG706", Severity.ERROR, "protocol-model-violation",
+            "The explicit-state protocol model checker found an "
+            "interleaving (with at most one crash injected after a "
+            "durable step) that violates a store/replication "
+            "invariant: an acked record is lost, two sealers enter "
+            "the critical section, the manifest dangles, a fence "
+            "moves backwards, or a replayed view diverges from the "
+            "log.  The diagnostic carries the violating schedule.",
+        ),
+        Rule(
+            "SG707", Severity.WARNING, "unknown-protocol-annotation",
+            "A '# protocol:' annotation names a role the protocol "
+            "pass does not know, or attaches to no function: the "
+            "discipline it was meant to declare is unchecked.",
         ),
     ]
 }

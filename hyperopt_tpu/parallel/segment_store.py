@@ -396,7 +396,7 @@ class SegmentStore:
     def append(self, doc):
         self.append_many([doc])
 
-    def append_many(self, docs):
+    def append_many(self, docs):  # protocol: cursor-advance
         """Group-commit a batch of trial-state transitions: ONE
         ``O_APPEND`` write + ONE fsync covers every doc in ``docs``
         (the ≥10x fsyncs-per-transition win over per-doc at batch
@@ -465,7 +465,7 @@ class SegmentStore:
         return False
 
     # -- sealing -------------------------------------------------------
-    def _seal_lock_acquire(self, timeout=10.0):
+    def _seal_lock_acquire(self, timeout=10.0):  # protocol: lock-break
         """Cross-process seal/compaction mutex: O_CREAT|O_EXCL lock
         file, stale-broken after 30s (a SIGKILL'd sealer must not wedge
         the store forever)."""

@@ -266,7 +266,7 @@ def _rebuild_manifest(sdir, seg_paths, parse, object_hook):
     }
 
 
-def _fsck_segments(qdir, repair, report: FsckReport) -> dict:
+def _fsck_segments(qdir, repair, report: FsckReport) -> dict:  # protocol: orphan-sweep
     """FS410/FS411/FS412 over ``<qdir>/segments``; returns the replayed
     {tid: doc} view so the lease/lock/cursor/counter rules see segment-
     stored trials exactly like per-doc ones.  Empty dict when the queue

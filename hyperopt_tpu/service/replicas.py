@@ -241,7 +241,7 @@ class StudyLeaseStore:
 
     # -- the cross-process critical section ----------------------------
     @contextlib.contextmanager
-    def _claim_locked(self, study_id, timeout=10.0):
+    def _claim_locked(self, study_id, timeout=10.0):  # protocol: lock-break
         lock = self._claim_lock_path(study_id)
         with self._claim_mutex:
             deadline = time.monotonic() + float(timeout)
@@ -260,9 +260,23 @@ class StudyLeaseStore:
                         except OSError:
                             continue
                         if age > self.ttl:
+                            # break the stale lock by renaming it to a
+                            # private name first: only ONE breaker wins
+                            # the rename, so two claimants that both
+                            # judged the lock stale cannot end up
+                            # inside the critical section concurrently
+                            # (unlinking the shared path directly
+                            # could remove a fresh lock another
+                            # claimant just re-created — the same race
+                            # the segment store's seal-lock break
+                            # closed)
+                            stale = "%s.stale-%d-%d" % (
+                                lock, os.getpid(), time.monotonic_ns()
+                            )
                             try:
-                                os.unlink(lock)
-                            except FileNotFoundError:
+                                os.rename(lock, stale)  # durability: exempt(lock break: the lock file carries no data; the rename IS the mutual exclusion)
+                                os.unlink(stale)
+                            except OSError:
                                 pass
                             continue
                         raise TimeoutError(
@@ -279,7 +293,7 @@ class StudyLeaseStore:
                     pass
 
     # -- mutations (all under the claim lock) --------------------------
-    def claim(self, study_id, owner, ttl=None):
+    def claim(self, study_id, owner, ttl=None):  # protocol: replication-write
         """Claim ownership: the new fence token (int), or None when a
         DIFFERENT replica holds a live lease.  Re-claiming a study we
         already hold renews it and returns the existing fence (no
@@ -606,7 +620,7 @@ class SegmentMirror:
         dst = os.path.join(self.dst_root, "studies", str(study_id))
         return src, dst
 
-    def pull_study(self, study_id) -> dict:
+    def pull_study(self, study_id) -> dict:  # protocol: replication-write
         from ..parallel import segment_store as sstore
         from ..parallel.file_trials import _read_doc, attachment_filename
         from .core import (

@@ -1,6 +1,8 @@
 """CI lint entry point: self-lint the repo with hyperopt_tpu.analysis.
 
-Runs, in order of cost:
+Runs the shared ``analysis.run_self_lint()`` sections (the SAME list
+``python -m hyperopt_tpu.analysis self`` runs — one package walk, one
+discovery read, one pass ordering), in order of cost:
 
 1. **race pass** over every auto-discovered lock-bearing module of the
    package — ``# guarded-by`` / ``# lock-order`` enforcement, the
@@ -11,17 +13,25 @@ Runs, in order of cost:
 3. **program pass, static** — the jax.jit donation contract, the PL206
    partition pin sites, and the PL208 dispatch-container call sites
    (no jax import);
-4. **space pass** over every ``examples/`` space and the QUALITY.md
+4. **protocol pass** (SG7xx) over every ``protocol:``-annotated module
+   plus the **protocol model check** — the explicit-state
+   interleaving/crash checker over the segment store and replication
+   plane (small scope by default; ``--deep`` runs the full sweep);
+5. **space pass** over every ``examples/`` space and the QUALITY.md
    benchmark domains (imports jax transitively via hyperopt_tpu);
-5. with ``--trace``: the live jaxpr audit of the fused suggest program
+6. with ``--trace``: the live jaxpr audit of the fused suggest program
    (host callbacks, f64 demotion, and the PL206/PL207 partition audit
    on the virtual mesh — runs a small CPU probe);
-6. with ``--audit [N]``: the N-trial (default 200) recompilation audit.
+7. with ``--audit [N]``: the N-trial (default 200) recompilation audit.
 
 The self-lint is a HARD CI gate: error diagnostics exit nonzero (the
 rule set is mature — every shipped module lints clean).  ``--no-gate``
-is the escape hatch: report-only, always exit 0.  Run:
-``python scripts/lint.py [--fast]``.
+is the escape hatch: report-only, always exit 0.  ``--json`` emits the
+same stable ``[{rule, severity, file, line, message, hint}]`` schema
+as ``python -m hyperopt_tpu.analysis --json`` so CI can upload a
+machine-readable artifact.  Per-pass wall times are printed on a
+``== timing:`` line; the ``--fast`` gate is budgeted (and tested) to
+finish within 60 seconds.  Run: ``python scripts/lint.py [--fast]``.
 """
 
 import argparse
@@ -74,56 +84,52 @@ def main(argv=None):
                     help="report-only: always exit 0 (the escape hatch; "
                          "the default is a hard gate on error "
                          "diagnostics)")
+    ap.add_argument("--deep", action="store_true",
+                    help="protocol model: full interleaving sweep "
+                         "(crash budget 2) instead of the small scope")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the stable machine-readable schema "
+                         "[{rule, severity, file, line, message, hint}] "
+                         "instead of the human report (timing goes to "
+                         "stderr)")
     # back-compat: --strict was the opt-in gate before the gate became
     # the default; it is now a no-op kept so existing CI lines work
     ap.add_argument("--strict", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
 
+    import json
+    import time
+
     from hyperopt_tpu.analysis import (
         Severity,
-        discover_race_files,
+        diagnostics_json,
         format_report,
-        lint_durability,
-        lint_programs,
-        lint_races,
         lint_space,
-        package_files,
+        run_self_lint,
     )
 
-    # one package walk + one discovery read feed all three passes
-    pkg = package_files()
-    race_files = discover_race_files(paths=pkg)
-    diags = list(lint_races(race_files))
-    print(format_report(
-        diags,
-        header=f"== race pass ({len(race_files)} lock-bearing modules, "
-               f"guarded-by/lock-order/lock-graph)",
-    ))
-
-    dur = lint_durability(pkg)
-    print(format_report(
-        dur,
-        header=f"== durability pass ({len(pkg)} modules, "
-               f"write-site discipline)",
-    ))
-    diags += dur
-
-    prog = lint_programs(static_only=True, paths=pkg)
-    print(format_report(
-        prog,
-        header="== program pass (donation + pin sites + dispatch "
-               "containers, static)",
-    ))
-    diags += prog
+    t_start = time.perf_counter()
+    diags = []
+    timings = []
+    # the shared self-lint sections (one package walk, one discovery
+    # read) — identical to `python -m hyperopt_tpu.analysis self`
+    for key, header, ds, secs in run_self_lint(deep=args.deep):
+        diags += ds
+        timings.append((key, secs))
+        if not args.as_json:
+            print(format_report(ds, header=header))
 
     if not args.fast:
+        t0 = time.perf_counter()
         spaces = _example_spaces() + _quality_domains()
         for name, space in spaces:
             ds = lint_space(space)
-            if ds:
+            if ds and not args.as_json:
                 print(format_report(ds, header=f"== space pass: {name}"))
             diags += ds
-        print(f"== space pass: {len(spaces)} spaces checked")
+        timings.append(("space", time.perf_counter() - t0))
+        if not args.as_json:
+            print(f"== space pass: {len(spaces)} spaces checked")
 
         if args.trace or args.audit is not None:
             from hyperopt_tpu.analysis import (
@@ -135,26 +141,40 @@ def main(argv=None):
             requests = capture_requests()
             tr = lint_traced_program(requests)
             tr.extend(lint_partition_program(requests))
-            print(format_report(
-                tr, header="== program pass (jaxpr trace + partition "
-                           "audit)",
-            ))
+            if not args.as_json:
+                print(format_report(
+                    tr, header="== program pass (jaxpr trace + "
+                               "partition audit)",
+                ))
             diags += tr
         if args.audit is not None:
             from hyperopt_tpu.analysis import audit_tpe_run
 
             aud = audit_tpe_run(n_trials=args.audit)
             ds = aud.diagnostics()
-            print(
-                f"== recompilation audit: {aud.n_traces} trace(s) / "
-                f"{aud.n_programs} program key(s) over {args.audit} "
-                f"trials; buckets={aud.bucket_summary()}"
-            )
-            print(format_report(ds))
+            if not args.as_json:
+                print(
+                    f"== recompilation audit: {aud.n_traces} trace(s) / "
+                    f"{aud.n_programs} program key(s) over {args.audit} "
+                    f"trials; buckets={aud.bucket_summary()}"
+                )
+                print(format_report(ds))
             diags += ds
 
+    total = time.perf_counter() - t_start
+    timing_line = "== timing: " + " ".join(
+        f"{key}={secs:.2f}s" for key, secs in timings
+    ) + f" total={total:.2f}s"
+    if args.as_json:
+        # machine-readable artifact on stdout; timing stays on stderr
+        print(timing_line, file=sys.stderr)
+        print(json.dumps(diagnostics_json(diags), indent=1))
+    else:
+        print(timing_line)
+
     n_err = sum(1 for d in diags if d.severity == Severity.ERROR)
-    print(f"\nlint: {len(diags)} diagnostic(s), {n_err} error(s)")
+    if not args.as_json:
+        print(f"\nlint: {len(diags)} diagnostic(s), {n_err} error(s)")
     if args.no_gate:
         return 0
     return min(n_err, 125)

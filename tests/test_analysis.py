@@ -1,4 +1,6 @@
-"""Tests for hyperopt_tpu.analysis — the four-pass static analyzer.
+"""Tests for hyperopt_tpu.analysis — the five-pass static analyzer.
+(The SG7xx protocol pass and the explicit-state protocol model have
+their own suite in test_protocol_analysis.py.)
 
 Structure mirrors the acceptance contract:
 

@@ -1,0 +1,491 @@
+"""Tests for the SG7xx protocol pass + the explicit-state model checker.
+
+Acceptance contract of the protocol tier:
+
+- every SG7xx rule has at least one positive fixture (fires, and fires
+  ONLY its intended id) and one negative fixture (the shipped idiom
+  passes clean);
+- the shipped tree protocol-lints to ZERO diagnostics and the
+  small-scope model check finds no violation (the hard-gate baseline);
+- **mutation validation**: each of the four PR 16 protocol bugs
+  (post-takeover mirror clobber, non-contiguous cursor advance,
+  orphan-sweep record loss, seal-lock break race) re-injected into its
+  model scenario produces a violating trace, printed as a
+  human-readable schedule — if a guard or invariant is ever weakened,
+  these fail before the model silently passes everything;
+- registry/docs drift: every rule id named in the docs is registered
+  and every registered rule is documented (FS4xx fsck repair ids are
+  checked against the fsck source the same way);
+- the CI surfaces: scripts/lint.py ``--json`` schema + timing line +
+  the 60-second ``--fast`` budget, and the ``__main__`` target
+  inference (bare ``.py`` → race+durability, module → space pass).
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from hyperopt_tpu.analysis import (
+    RULES,
+    discover_protocol_files,
+    lint_protocol,
+    run_self_lint,
+)
+from hyperopt_tpu.analysis.protocol_lint import (
+    ROLES,
+    lint_source as pl_lint_source,
+)
+from hyperopt_tpu.analysis.protocol_model import (
+    MUTATIONS,
+    SCENARIOS,
+    build_scenario,
+    check_all,
+    check_mutation,
+    find_violation,
+    format_schedule,
+    model_check_diagnostics,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ENV = dict(os.environ, JAX_PLATFORMS="cpu")
+
+
+def _rules(source):
+    return [d.rule for d in pl_lint_source(textwrap.dedent(source))]
+
+
+# ---------------------------------------------------------------------
+# SG7xx fixture corpus: one positive + one negative per rule
+# ---------------------------------------------------------------------
+
+
+def test_sg705_sg701_missing_owner_and_fence():
+    # a replication write with neither an ownership check nor a fence
+    # validation: both disciplines fire, nothing else
+    assert _rules("""
+        def pull(dst):  # protocol: replication-write
+            _atomic_write(dst + "/seg1", b"x")
+            _write_doc(dst + "/manifest.json", {})
+    """) == ["SG705", "SG701"]
+
+
+def test_sg701_durable_write_between_fence_and_commit():
+    assert _rules("""
+        def pull(dst):  # protocol: replication-write
+            owner_of(dst)
+            read_fence(dst)
+            _atomic_write(dst + "/sidecar", b"x")
+            _write_doc(dst + "/manifest.json", {})
+    """) == ["SG701"]
+
+
+def test_sg702_write_after_manifest_publish():
+    assert _rules("""
+        def pull(dst):  # protocol: replication-write
+            owner_of(dst)
+            read_fence(dst)
+            _write_doc(dst + "/manifest.json", {})
+            _atomic_write(dst + "/sidecar", b"x")
+    """) == ["SG702"]
+
+
+def test_replication_write_shipped_idiom_clean():
+    # ownership check first, payload copies, fence re-check, manifest
+    # LAST — the pull_study shape
+    assert _rules("""
+        def pull(dst):  # protocol: replication-write
+            if owner_of(dst):
+                return
+            _atomic_write(dst + "/seg1", b"x")
+            _atomic_write(dst + "/sidecar", b"x")
+            read_fence(dst)
+            _write_doc(dst + "/manifest.json", {})
+    """) == []
+
+
+def test_sg703_max_cursor_advance_fires_file_wide():
+    # no annotation needed: max()-advance of a cursorish target is
+    # flagged anywhere in a protocol module
+    assert _rules("""
+        def advance(self, seg, end):
+            self._offsets[seg] = max(self._offsets.get(seg, 0), end)
+    """) == ["SG703"]
+
+
+def test_sg703_unguarded_advance_in_declared_site():
+    assert _rules("""
+        def advance(self, seg, end, nbytes):  # protocol: cursor-advance
+            self._offsets[seg] = end
+    """) == ["SG703"]
+
+
+def test_sg703_contiguity_guarded_advance_clean():
+    # the PR 16 fixed idiom: advance only when contiguous with the
+    # cursor; a gap is left for the next refresh to replay
+    assert _rules("""
+        def advance(self, seg, end, nbytes):  # protocol: cursor-advance
+            if self._offsets.get(seg, 0) == end - nbytes:
+                self._offsets[seg] = end
+    """) == []
+
+
+def test_sg704_shared_lock_unlink_in_acquire_path():
+    assert _rules("""
+        def acquire(lock_path):
+            while True:
+                try:
+                    return os.open(lock_path, os.O_CREAT | os.O_EXCL)
+                except FileExistsError:
+                    os.unlink(lock_path)  # protocol: lock-break
+                    continue
+    """) == ["SG704"]
+
+
+def test_sg704_rename_before_unlink_clean():
+    # the fixed idiom: rename the lock to a private name first — only
+    # one breaker wins the rename, so a fresh lock another breaker
+    # re-created can never be removed
+    assert _rules("""
+        def acquire(lock_path):  # protocol: lock-break
+            while True:
+                try:
+                    return os.open(lock_path, os.O_CREAT | os.O_EXCL)
+                except FileExistsError:
+                    stale = lock_path + ".stale"
+                    os.rename(lock_path, stale)
+                    os.unlink(stale)
+                    continue
+    """) == []
+
+
+def test_sg701_orphan_sweep_without_rehome():
+    assert _rules("""
+        def sweep(orphans):  # protocol: orphan-sweep
+            for path in orphans:
+                os.unlink(path)
+    """) == ["SG701"]
+
+
+def test_orphan_sweep_with_rehome_clean():
+    assert _rules("""
+        def sweep(orphans, active):  # protocol: orphan-sweep
+            for path, records in orphans:
+                append_records(active, records)
+                os.unlink(path)
+    """) == []
+
+
+def test_sg707_unknown_role():
+    assert _rules("""
+        def f():  # protocol: segment-write
+            pass
+    """) == ["SG707"]
+
+
+def test_sg707_unattached_annotation():
+    assert _rules("""
+        # protocol: lock-break
+        X = 1
+    """) == ["SG707"]
+
+
+def test_annotation_attaches_line_above_and_enclosing():
+    # line-above and innermost-enclosing attachment both govern the
+    # same def as the same-line form
+    above = _rules("""
+        # protocol: orphan-sweep
+        def sweep(orphans):
+            for path in orphans:
+                os.unlink(path)
+    """)
+    inside = _rules("""
+        def sweep(orphans):
+            # protocol: orphan-sweep
+            for path in orphans:
+                os.unlink(path)
+    """)
+    assert above == inside == ["SG701"]
+
+
+def test_annotation_inside_string_is_not_parsed():
+    # mirroring the race pass: grammar examples in docstrings are inert
+    assert _rules('''
+        def helper():
+            """Document the marker: # protocol: orphan-sweep ."""
+            os.unlink("scratch")
+    ''') == []
+
+
+def test_sg_lint_disable_comment_suppression():
+    src = textwrap.dedent("""
+        def advance(self, seg, end):
+            self._offsets[seg] = max(self._offsets.get(seg, 0), end)  # lint: disable=SG703
+    """)
+    assert pl_lint_source(src) == []
+
+
+# ---------------------------------------------------------------------
+# shipped-tree baseline: discovery + zero diagnostics
+# ---------------------------------------------------------------------
+
+
+def test_discovery_finds_exactly_the_protocol_modules():
+    names = {os.path.basename(p) for p in discover_protocol_files()}
+    assert names == {"segment_store.py", "replicas.py", "fsck.py"}
+
+
+def test_repo_protocol_lint_zero_diagnostics():
+    assert lint_protocol() == []
+
+
+def test_self_lint_sections_include_protocol_and_model():
+    sections = run_self_lint(static_only=True)
+    keys = [k for k, _h, _d, _s in sections]
+    assert keys == ["race", "durability", "program", "protocol", "model"]
+    for _k, _h, diags, secs in sections:
+        assert diags == []
+        assert secs >= 0.0
+
+
+# ---------------------------------------------------------------------
+# Tier B: model checker — clean protocols pass, every PR 16 bug caught
+# ---------------------------------------------------------------------
+
+
+def test_model_clean_scenarios_no_violation():
+    results = check_all()
+    assert {n for n, _ in results} == set(SCENARIOS)
+    for name, violation in results:
+        assert violation is None, format_schedule(violation)
+
+
+def test_model_check_diagnostics_empty_on_shipped_protocol():
+    assert model_check_diagnostics() == []
+
+
+@pytest.mark.parametrize("bug", sorted(MUTATIONS))
+def test_model_mutation_validation(bug):
+    """Re-inject each PR 16 bug into its scenario: the checker must
+    find a violating trace and print it as a readable schedule."""
+    violation = check_mutation(bug)
+    assert violation is not None, f"model failed to catch {bug}"
+    assert violation.scenario == f"{MUTATIONS[bug]} (bug={bug})"
+    text = format_schedule(violation)
+    assert text.startswith(f"schedule ({MUTATIONS[bug]} (bug={bug})):")
+    # one numbered `<process>.<step>` line per step, in execution order
+    steps = re.findall(r"^\s+(\d+)\. \w+\.\w+", text, re.MULTILINE)
+    assert [int(s) for s in steps] == list(range(1, len(steps) + 1))
+    assert steps, "schedule must list the interleaving"
+    assert "\nviolated: " in text
+
+
+def test_model_mutation_rejects_mismatched_scenario():
+    with pytest.raises(ValueError):
+        build_scenario("appender-cursor", bug="mirror-clobber")
+    with pytest.raises(KeyError):
+        build_scenario("no-such-scenario")
+
+
+def test_seal_lock_mutation_schedule_shows_double_break():
+    # the canonical counterexample: both sealers judge the same lock
+    # stale before either breaks it
+    v = find_violation(build_scenario("seal-lock", bug="unlink-lock-break"))
+    assert v is not None
+    assert v.invariant == "single-sealer"
+    text = format_schedule(v)
+    assert text.count("break_unlink_shared") == 2
+
+
+@pytest.mark.slow
+def test_model_deep_sweep_clean():
+    """Full sweep (crash budget 2) over every scenario stays green —
+    the `--deep` CI tier."""
+    for name, violation in check_all(deep=True):
+        assert violation is None, f"{name}: {format_schedule(violation)}"
+
+
+@pytest.mark.slow
+def test_model_deep_sweep_still_catches_mutations():
+    for bug in sorted(MUTATIONS):
+        assert check_mutation(bug, deep=True) is not None, bug
+
+
+# ---------------------------------------------------------------------
+# registry / docs drift
+# ---------------------------------------------------------------------
+
+_ID_RE = re.compile(r"\b(?:SP1|PL2|RL3|DL4|SG7)\d\d\b")
+
+
+def test_rule_registry_matches_docs():
+    """Every analyzer rule id named in the docs is registered, and
+    every registered rule is documented — the catalog cannot rot."""
+    with open(os.path.join(_REPO, "docs", "static_analysis.md")) as f:
+        documented = set(_ID_RE.findall(f.read()))
+    registered = set(RULES)
+    assert documented - registered == set(), "docs name unknown rules"
+    assert registered - documented == set(), "registered rules undocumented"
+
+
+def test_registered_sg_rules_are_exactly_the_family():
+    assert {r for r in RULES if r.startswith("SG")} == {
+        "SG701", "SG702", "SG703", "SG704", "SG705", "SG706", "SG707",
+    }
+    assert len(ROLES) == 4
+
+
+def test_fsck_repair_ids_match_docs():
+    """FS4xx ids are fsck *repair* rules, not analyzer rules: the set
+    in the fsck source must equal the set in the docs, and none may
+    leak into the analyzer registry."""
+    fs_re = re.compile(r"\bFS4\d\d\b")
+    with open(os.path.join(
+        _REPO, "hyperopt_tpu", "resilience", "fsck.py",
+    )) as f:
+        in_source = set(fs_re.findall(f.read()))
+    in_docs = set()
+    for doc in ("resilience.md", "storage.md"):
+        with open(os.path.join(_REPO, "docs", doc)) as f:
+            in_docs |= set(fs_re.findall(f.read()))
+    assert in_source == in_docs
+    assert in_source, "fsck repair rules must exist"
+    assert not any(r.startswith("FS") for r in RULES)
+
+
+# ---------------------------------------------------------------------
+# CI surfaces: scripts/lint.py --json / timing budget, CLI targets
+# ---------------------------------------------------------------------
+
+
+def _run(args, timeout=300):
+    return subprocess.run(
+        args, capture_output=True, text=True, cwd=_REPO, env=_ENV,
+        timeout=timeout,
+    )
+
+
+def test_scripts_lint_fast_timing_and_budget():
+    """--fast prints per-pass wall times and finishes inside the
+    60-second budget the docstring promises."""
+    proc = _run([sys.executable, os.path.join("scripts", "lint.py"),
+                 "--fast"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    m = re.search(r"^== timing: (.*) total=([\d.]+)s$",
+                  proc.stdout, re.MULTILINE)
+    assert m, proc.stdout
+    keys = [kv.split("=")[0] for kv in m.group(1).split()]
+    assert keys == ["race", "durability", "program", "protocol", "model"]
+    assert float(m.group(2)) < 60.0, "--fast blew the 60s budget"
+
+
+def test_scripts_lint_json_schema():
+    """--json: stable sorted schema on stdout (empty on the clean
+    tree), timing on stderr so the artifact stays parseable."""
+    proc = _run([sys.executable, os.path.join("scripts", "lint.py"),
+                 "--fast", "--json"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout) == []
+    assert "== timing:" in proc.stderr
+    assert "== timing:" not in proc.stdout
+
+
+def test_cli_protocol_target():
+    proc = _run([sys.executable, "-m", "hyperopt_tpu.analysis",
+                 "protocol"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "protocol_lint (SG7xx + model check)" in proc.stdout
+
+
+def test_cli_protocol_target_json_on_seeded_violation(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        def sweep(orphans):  # protocol: orphan-sweep
+            for path in orphans:
+                os.unlink(path)
+    """))
+    proc = _run([sys.executable, "-m", "hyperopt_tpu.analysis",
+                 "protocol", str(bad), "--json"])
+    rows = json.loads(proc.stdout)
+    # exit code = error count: the seeded SG701 (the clean-tree model
+    # check contributes zero)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert [r["rule"] for r in rows] == ["SG701"]
+    assert set(rows[0]) == {
+        "rule", "severity", "file", "line", "message", "hint",
+    }
+    assert rows[0]["line"] == 4 and rows[0]["hint"]
+
+
+def test_cli_infers_bare_py_file_as_race_plus_durability(tmp_path):
+    bad = tmp_path / "bad.py"
+    # one race violation (guarded field written without its lock) and
+    # one durability violation (truncate-then-write of a live path)
+    bad.write_text(textwrap.dedent("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.n = 0  # guarded-by: lock
+
+            def bump(self):
+                self.n += 1
+
+        def save(path, data):
+            with open(path, "w") as f:
+                f.write(data)
+    """))
+    proc = _run([sys.executable, "-m", "hyperopt_tpu.analysis",
+                 str(bad), "--json"])
+    rows = json.loads(proc.stdout)
+    fired = {r["rule"] for r in rows}
+    assert "RL301" in fired and "DL401" in fired, rows
+    assert proc.returncode == len(
+        [r for r in rows if r["severity"] == "error"]
+    )
+
+
+def test_cli_infers_module_as_space_pass(tmp_path):
+    mod = tmp_path / "my_space.py"
+    mod.write_text(textwrap.dedent("""
+        from hyperopt_tpu import hp
+
+        space = {"x": hp.uniform("x", 0.0, 1.0)}
+    """))
+    proc = _run([sys.executable, "-m", "hyperopt_tpu.analysis",
+                 str(mod).replace(".py", "") + ".py:space", "--json"])
+    # a .py path with :attr is a space target, not file inference
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout) == []
+
+
+def test_cli_json_schema_stable_across_targets(tmp_path):
+    """The --json schema is identical for every target: same keys,
+    same ordering contract (sorted by file, line, rule)."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        def sweep(orphans):  # protocol: orphan-sweep
+            os.unlink(orphans)
+
+        def save(path, data):
+            with open(path, "w") as f:
+                f.write(data)
+    """))
+    per_target = {}
+    for target in ("protocol", "durability"):
+        proc = _run([sys.executable, "-m", "hyperopt_tpu.analysis",
+                     target, str(bad), "--json"])
+        rows = json.loads(proc.stdout)
+        assert rows, f"{target} found nothing"
+        per_target[target] = rows
+        for row in rows:
+            assert list(row) == [
+                "rule", "severity", "file", "line", "message", "hint",
+            ]
+    assert [r["rule"] for r in per_target["protocol"]] == ["SG701"]
+    assert [r["rule"] for r in per_target["durability"]] == ["DL401"]
