@@ -1078,6 +1078,60 @@ def failover_section(argv):
     return 0 if report["ok"] else 1
 
 
+def control_section(argv):
+    """``python bench.py --control [--quick]``: the closed-loop
+    control-plane A/B (scripts/control_report.py) — the SAME seeded
+    shifting-load profile against a static server and a --self-tune
+    server.  Gates: self-tuned warm p99 no worse (platform-calibrated
+    tolerance), zero SL6xx breach transitions in the self-tuned arm,
+    every applied decision present in BOTH the decision journal and
+    the knob-provenance journal, and the deterministic forced-breach
+    fixture proving revert-to-static within one observation window.
+    A quick run writes CONTROL_SERVE.quick.json so CI can never
+    clobber the committed full artifact (the PR 7 convention).
+    Prints ONE JSON line like the other bench sections."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    control_report = _import_script("control_report")
+    quick = "--quick" in argv
+    out_path = (
+        "CONTROL_SERVE.quick.json" if quick else "CONTROL_SERVE.json"
+    )
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    t0 = time.time()
+    profile = [
+        dict(p) for p in control_report.serve_loadgen.DEFAULT_PROFILE
+    ]
+    window_s = 1.0
+    if quick:
+        for p in profile:
+            p["trials"] = min(int(p["trials"]), 4)
+        window_s = 0.5
+    report = control_report.run_ab(profile=profile, window_s=window_s)
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+    out = {
+        "metric": "control_serve_ab",
+        "value": report["self_tuned"]["controller"].get("n_evaluated"),
+        "unit": "evaluated_windows",
+        "ok": report["ok"],
+        "gates": report["gates"],
+        "static_warm_p99_ms": report["static"]["suggest_warm_p99_ms"],
+        "self_tuned_warm_p99_ms": (
+            report["self_tuned"]["suggest_warm_p99_ms"]
+        ),
+        "n_applied_decisions": report["decision_audit"]["n_applied"],
+        "breach_transitions": (
+            report["self_tuned"]["breach_transitions"]
+        ),
+        "artifact": out_path,
+        "elapsed_s": round(time.time() - t0, 2),
+    }
+    print(json.dumps(out))
+    return 0 if report["ok"] else 1
+
+
 def store_section(argv):
     """``python bench.py --store [--quick]``: storage-plane A/B — the
     per-doc layout vs the segmented append-only trial log
@@ -1117,6 +1171,9 @@ def store_section(argv):
 
 
 def main():
+    if "--control" in sys.argv[1:]:
+        argv = [a for a in sys.argv[1:] if a != "--control"]
+        return control_section(argv)
     if "--store" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--store"]
         return store_section(argv)

@@ -416,6 +416,13 @@ class ServiceStats:
         self._n_inline = 0            # host-side suggests (startup/rand)
         self._dispatch_s = 0.0
         self._queue_depth = 0         # last-observed scheduler queue depth
+        # cumulative depth accounting: every observation adds to the
+        # sum, so a window delta (sum/samples) yields the MEAN depth
+        # over that window — the controller's objective term.  Sampled
+        # at request arrival AND at batch dispatch (a quiet tenant's
+        # drained queue is an observation too, not a blind spot).
+        self._queue_depth_sum = 0     # sum of observed depths
+        self._queue_depth_samples = 0  # number of observations
         self._n_studies = 0
         # compile-plane accounting (hyperopt_tpu.compile_ledger):
         # cold suggests overall, cold suggests AFTER the service first
@@ -538,6 +545,8 @@ class ServiceStats:
     def set_queue_depth(self, n: int):
         with self._lock:
             self._queue_depth = int(n)
+            self._queue_depth_sum += int(n)
+            self._queue_depth_samples += 1
 
     def set_n_studies(self, n: int):
         with self._lock:
@@ -612,6 +621,11 @@ class ServiceStats:
                 "suggests_cold": self._n_cold_suggests,
                 "suggests_cold_after_ready": self._n_cold_after_ready,
                 "cold_fallbacks": self._n_cold_fallbacks,
+                # cumulative queue-depth accounting: a window delta of
+                # sum/samples is the mean depth over that window (the
+                # control plane's backlog objective term)
+                "queue_depth_sum": self._queue_depth_sum,
+                "queue_depth_samples": self._queue_depth_samples,
             }
 
     def window_quantiles(self):
@@ -684,6 +698,13 @@ class ServiceStats:
                 ),
                 "dispatch_s": round(self._dispatch_s, 6),
                 "queue_depth": self._queue_depth,
+                "queue_depth_mean": (
+                    round(
+                        self._queue_depth_sum
+                        / self._queue_depth_samples, 4,
+                    )
+                    if self._queue_depth_samples else None
+                ),
                 "n_studies": self._n_studies,
                 "n_cold_suggests": self._n_cold_suggests,
                 "n_cold_after_ready": self._n_cold_after_ready,
@@ -1361,6 +1382,7 @@ def render_prometheus(
     study_health: dict = None,
     store: "StoreStats" = None,
     slo: list = None,
+    control: dict = None,
     build: dict = None,
     extra: dict = None,
     namespace: str = "hyperopt",
@@ -1385,6 +1407,10 @@ def render_prometheus(
     ``store``: a :class:`StoreStats` — the storage-plane gauge block.
     ``slo``: a list of SLO rule rows (``hyperopt_tpu.slo.SloEngine
     .metrics_rows``) — status/burn-rate/breaches per SL6xx rule.
+    ``control``: the control-plane block
+    (``hyperopt_tpu.control.ControlStats.control_metrics``) —
+    self-tuning decision counters, the last objective, the frozen
+    flag, and the SH5xx admission-reclaim counter.
     ``build``: the :func:`build_info` labels dict — one
     ``hyperopt_build_info{version,jax,backend} 1`` identity gauge.
     """
@@ -1784,6 +1810,37 @@ def render_prometheus(
         for row in slo:
             sample("slo_breaches_total", {"rule": row["rule"]},
                    row.get("breaches_total", 0))
+
+    if control is not None:
+        head("control_decisions_total",
+             "Closed-loop controller decisions by outcome (proposed/"
+             "applied/evaluated/discarded/reverted/held/rearmed).",
+             "counter")
+        for outcome, n in sorted(control.get("decisions", {}).items()):
+            sample("control_decisions_total", {"outcome": outcome}, n)
+        head("control_objective",
+             "Last evaluated controller objective (weighted warm p99 + "
+             "queue depth, duty-cycle tie-break; lower is better).",
+             "gauge")
+        sample("control_objective", None, control.get("objective"))
+        head("control_frozen",
+             "1 while the controller is frozen (post-revert backoff; "
+             "knobs pinned to the static config).", "gauge")
+        sample("control_frozen", None, control.get("frozen", 0))
+        head("control_freezes_total",
+             "Controller freeze transitions (breach- or exception-"
+             "triggered reverts to the static config).", "counter")
+        sample("control_freezes_total", None,
+               control.get("freezes_total", 0))
+        head("control_reclaimed_studies_total",
+             "Admission slots reclaimed from SH5xx-stopped studies "
+             "(per-study early_stop opt-in).", "counter")
+        sample("control_reclaimed_studies_total", None,
+               control.get("reclaimed_studies_total", 0))
+        head("control_resumed_studies_total",
+             "Stopped studies re-admitted via resume.", "counter")
+        sample("control_resumed_studies_total", None,
+               control.get("resumed_studies_total", 0))
 
     if build is not None:
         head("build_info",

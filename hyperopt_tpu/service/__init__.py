@@ -40,6 +40,7 @@ from .core import (
     StudyExists,
     StudyNotFound,
     StudyRegistry,
+    StudyStopped,
     SuggestScheduler,
     canonical_json,
     decode_space,
@@ -76,6 +77,7 @@ __all__ = [
     "StudyLeaseStore",
     "StudyNotFound",
     "StudyRegistry",
+    "StudyStopped",
     "SuggestScheduler",
     "canonical_json",
     "decode_space",

@@ -187,6 +187,34 @@ def make_parser():
              "entries — off by default, startup refuses the collision",
     )
     p.add_argument(
+        "--self-tune", action="store_true", dest="self_tune",
+        help="closed-loop control plane: a background controller runs "
+             "TPE over the serving knobs themselves (batch window, "
+             "batch size k, speculation depth), scoring each config "
+             "over one SLO snapshot window and reverting to the static "
+             "config on any SL6xx breach.  Off by default — without "
+             "this flag the knob table is provably inert (the "
+             "scheduler reads the same static values every batch)",
+    )
+    p.add_argument(
+        "--control-window", type=float, default=30.0,
+        dest="control_window",
+        help="seconds each self-tune configuration is observed before "
+             "it is scored (one objective window)",
+    )
+    p.add_argument(
+        "--control-interval", type=float, default=0.0,
+        dest="control_interval",
+        help="idle seconds between self-tune cycles (0 = back-to-back "
+             "windows)",
+    )
+    p.add_argument(
+        "--control-seed", type=int, default=0, dest="control_seed",
+        help="RNG seed for the controller's own TPE search (its Trials "
+             "are journaled under <root>/control, so a restart resumes "
+             "the tuning history exactly)",
+    )
+    p.add_argument(
         "--chaos-config", default=None, dest="chaos_config",
         help="TESTING ONLY: JSON ChaosConfig activating seeded "
              "service-plane fault injection (torn writes, connection "
@@ -216,6 +244,10 @@ def _build_service(options, tracer, cache_dir, advertise_url):
         replica_ttl=options.replica_ttl,
         mirror_src_root=options.mirror_src_root,
         unsafe_shared_compile_cache=options.unsafe_shared_compile_cache,
+        control_enabled=options.self_tune,
+        control_window_s=options.control_window,
+        control_interval_s=options.control_interval,
+        control_seed=options.control_seed,
     )
 
 
@@ -308,6 +340,14 @@ def main(argv=None):
         logger.info(
             "mesh execution mode: %s over %d local device(s)",
             service.mesh_label, service.device_mesh.n_devices,
+        )
+    if service.controller is not None:
+        logger.info(
+            "self-tune controller ON: window=%.1fs interval=%.1fs "
+            "seed=%d knobs=%s",
+            options.control_window, options.control_interval,
+            options.control_seed,
+            ",".join(service.controller.status()["tuned"]),
         )
     # flight-recorder triggers beyond SLO breaches: SIGQUIT ("show me
     # what you were doing") and unhandled crashes (the post-mortem

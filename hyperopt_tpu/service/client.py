@@ -628,9 +628,9 @@ class ServiceClient:
         return self._request("GET", "/v1/warmup")
 
     def create_study(self, study_id, space, seed=0, algo="tpe",
-                     algo_params=None, exist_ok=False,
+                     algo_params=None, exist_ok=False, early_stop=None,
                      idempotency_key=None) -> dict:
-        return self._study_request(study_id, "POST", "/v1/studies", {
+        body = {
             "study_id": study_id,
             "space_b64": encode_space(space),
             "seed": int(seed),
@@ -641,7 +641,10 @@ class ServiceClient:
                 idempotency_key if idempotency_key is not None
                 else self._next_key()
             ),
-        })
+        }
+        if early_stop is not None:
+            body["early_stop"] = early_stop
+        return self._study_request(study_id, "POST", "/v1/studies", body)
 
     def suggest(self, study_id, n=1, idempotency_key=None) -> list:
         """[{"tid": int, "vals": {label: value}}, ...]"""
@@ -680,6 +683,25 @@ class ServiceClient:
         return self._study_request(
             study_id, "GET", f"/v1/studies/{_quote(study_id)}"
         )
+
+    def resume_study(self, study_id) -> dict:
+        """Re-admit a study stopped by its early-stop hook (subject to
+        the registry's active-study capacity)."""
+        return self._study_request(
+            study_id, "POST",
+            f"/v1/studies/{_quote(study_id)}/resume", {},
+        )
+
+    def get_config(self) -> dict:
+        """The runtime knob table: specs, live + static values, recent
+        provenance, and controller status."""
+        return self._request("GET", "/v1/config")
+
+    def set_config(self, knobs=None, revert=False) -> dict:
+        """Write serving knobs at runtime (localhost-only on the server
+        side).  ``revert=True`` restores the static config."""
+        body = {"revert": True} if revert else {"knobs": dict(knobs or {})}
+        return self._request("POST", "/v1/config", body)
 
     def replicas(self) -> dict:
         """The ``GET /v1/replicas`` replica-plane document (identity,

@@ -154,6 +154,12 @@ class StudyExists(ServiceError):
     """create_study collision without ``exist_ok`` (409)."""
 
 
+class StudyStopped(ServiceError):
+    """The study was stopped by its SH5xx early-stop hook and no
+    longer accepts suggests (409).  Reports for already-issued trials
+    still land; ``resume_study`` re-admits it (subject to capacity)."""
+
+
 class NotOwner(ServiceError):
     """This replica does not own the study (multi-replica mode).
 
@@ -492,12 +498,34 @@ class Study:
     """
 
     def __init__(self, study_id, space, seed, algo_name="tpe",
-                 algo_params=None, trials=None, mesh=None):
+                 algo_params=None, trials=None, mesh=None,
+                 early_stop=None):
         self.study_id = validate_study_id(study_id)
         self.space = space
         self.seed = int(seed)
         self.algo_name = str(algo_name)
         self.algo_params = dict(algo_params or {})
+        # SH5xx actuation opt-in (default OFF): with an early_stop
+        # config, the service checks no_progress_stop's criterion
+        # after every landed report; a firing study transitions to the
+        # terminal ``stopped`` state and releases its admission slot.
+        # The hook owns a private SearchStats (criterion parameters
+        # are the config's, not the study's display health).
+        self.early_stop = dict(early_stop) if early_stop else None
+        self.early_stop_fn = None
+        if self.early_stop is not None:
+            from ..control.actuation import build_stop_fn
+
+            self.early_stop_fn = build_stop_fn(
+                self.early_stop,
+                n_startup_jobs=int(
+                    (algo_params or {}).get("n_startup_jobs", 20)
+                ),
+            )
+        # terminal stop record ({"t", "rule", "detail", ...}), or None
+        # while active.  Written under self.lock; lock-free reads (the
+        # registry's capacity count) see an atomic reference.
+        self.stopped = None  # guarded-by: lock (writes)
         self.algo, self._prepare = _resolve_algo(
             self.algo_name, self.algo_params
         )
@@ -577,13 +605,18 @@ class Study:
         return getattr(self.trials, "jobs", None) is not None
 
     def config_blob(self) -> bytes:
-        return json.dumps({
+        cfg = {
             "study_id": self.study_id,
             "seed": self.seed,
             "algo_name": self.algo_name,
             "algo_params": self.algo_params,
             "space_b64": encode_space(self.space),
-        }, sort_keys=True).encode()
+        }
+        # only when opted in: studies without early_stop keep the
+        # exact pre-control-plane config bytes
+        if self.early_stop is not None:
+            cfg["early_stop"] = self.early_stop
+        return json.dumps(cfg, sort_keys=True).encode()
 
     def persist_config(self):
         if self.durable:
@@ -591,7 +624,8 @@ class Study:
                 self.config_blob()
             )
 
-    def config_matches(self, space, seed, algo_name, algo_params) -> bool:
+    def config_matches(self, space, seed, algo_name, algo_params,
+                       early_stop=None) -> bool:
         """Is the submitted config the one this study runs?  Guards the
         ``exist_ok`` attach path: silently serving suggestions from an
         OLD space to a client that re-created the study with a new one
@@ -600,6 +634,8 @@ class Study:
             int(seed) != self.seed
             or str(algo_name) != self.algo_name
             or dict(algo_params or {}) != self.algo_params
+            or (dict(early_stop) if early_stop else None)
+            != self.early_stop
         ):
             return False
         try:
@@ -775,6 +811,36 @@ class Study:
             )
         return self._apply_result(doc, result)
 
+    # -- SH5xx actuation (caller holds self.lock) ------------------------
+    def check_early_stop(self):
+        """Evaluate the opt-in stop criterion against the landed
+        results; transition to ``stopped`` (and return the record) the
+        first time it fires.  No-op without the opt-in, and idempotent
+        once stopped."""
+        if self.early_stop_fn is None or self.stopped is not None:  # lint: disable=RL301  caller holds lock
+            return None
+        from ..control.actuation import evaluate_stop
+
+        record = evaluate_stop(self.early_stop_fn, self.trials)
+        if record is not None:
+            self.stopped = record  # lint: disable=RL301  caller holds lock
+        return record
+
+    def resume(self):
+        """Reverse a stop: clear the terminal state and reset the
+        hook's private criterion counters (the stall window restarts —
+        an immediately re-fired stop would make resume useless)."""
+        self.stopped = None  # lint: disable=RL301  caller holds lock
+        if self.early_stop is not None:
+            from ..control.actuation import build_stop_fn
+
+            self.early_stop_fn = build_stop_fn(
+                self.early_stop,
+                n_startup_jobs=int(
+                    self.algo_params.get("n_startup_jobs", 20)
+                ),
+            )
+
     # -- startup recovery ------------------------------------------------
     def max_service_draw(self) -> int:
         """Highest seed-draw position evidenced by the store or the
@@ -840,6 +906,11 @@ class Study:
             "seed": self.seed,
             "algo": self.algo_name,
             "algo_params": self.algo_params,
+            # lifecycle: "stopped" is the SH5xx-actuated terminal state
+            # (slot released, suggests refused until resume)
+            "status": "stopped" if self.stopped is not None else "active",  # lint: disable=RL301  caller holds lock
+            "stopped": self.stopped,  # lint: disable=RL301  caller holds lock
+            "early_stop": self.early_stop,
             "n_trials": len(self.trials._dynamic_trials),
             "states": {str(k): v for k, v in counts.items()},
             "n_completed": counts[JOB_STATE_DONE],
@@ -942,6 +1013,7 @@ class StudyRegistry:
             algo_params=cfg.get("algo_params") or {},
             trials=trials,
             mesh=self.mesh,
+            early_stop=cfg.get("early_stop"),
         )
         # exactly-once recovery: re-apply journal entries whose
         # effects never landed (crash between journal append and
@@ -1019,8 +1091,17 @@ class StudyRegistry:
             self.install(study)
             self.recovery_info["recovered_studies"] += 1
 
+    def n_active(self) -> int:
+        """Studies holding an admission slot: registered and NOT in
+        the SH5xx-stopped terminal state (a stopped study's slot is
+        reclaimed — that is the actuation loop's whole point)."""
+        with self._studies_lock:
+            return sum(
+                1 for s in self._studies.values() if s.stopped is None
+            )
+
     def create(self, study_id, space, seed=0, algo_name="tpe",
-               algo_params=None, exist_ok=False) -> Study:
+               algo_params=None, exist_ok=False, early_stop=None) -> Study:
         study_id = validate_study_id(study_id)
         # _create_lock spans check → disk side effects → insert, so a
         # raced duplicate can never persist its config over the winner's
@@ -1028,11 +1109,17 @@ class StudyRegistry:
         with self._create_lock:
             with self._studies_lock:
                 existing = self._studies.get(study_id)
-                n_now = len(self._studies)
+                # capacity counts ACTIVE studies: slots reclaimed from
+                # SH5xx-stopped studies re-admit queued creates
+                n_now = sum(
+                    1 for s in self._studies.values()
+                    if s.stopped is None
+                )
             if existing is not None:
                 if exist_ok:
                     if not existing.config_matches(
-                        space, seed, algo_name, algo_params
+                        space, seed, algo_name, algo_params,
+                        early_stop=early_stop,
                     ):
                         raise StudyExists(
                             f"study {study_id!r} exists with a DIFFERENT "
@@ -1053,6 +1140,12 @@ class StudyRegistry:
             # space's real gate (compiles it, catches duplicate labels
             # etc.); the throwaway instance is cheap next to a create.
             _resolve_algo(str(algo_name), dict(algo_params or {}))
+            if early_stop is not None:
+                # validate the opt-in config side-effect free (400 on
+                # a malformed dict, same as a bad space)
+                from ..control.actuation import build_stop_fn
+
+                build_stop_fn(dict(early_stop))
             if "mesh" in (algo_params or {}):
                 # a per-study mesh may opt OUT of the service mesh
                 # ("off") or restate it — never introduce a different
@@ -1096,6 +1189,7 @@ class StudyRegistry:
                     study_id, space, seed,
                     algo_name=algo_name, algo_params=algo_params,
                     trials=trials, mesh=self.mesh,
+                    early_stop=early_stop,
                 )
                 study.persist_config()
             except Exception:
@@ -1226,10 +1320,23 @@ class SuggestScheduler:
     def __init__(self, stats: ServiceStats = None, device_recovery=None,
                  batch_window=DEFAULT_BATCH_WINDOW,
                  max_batch=DEFAULT_MAX_BATCH, max_queue=DEFAULT_MAX_QUEUE,
-                 cold_fallback=False, mesh_label="off"):
-        self.batch_window = float(batch_window)
-        self.max_batch = int(max_batch)
-        self.max_queue = int(max_queue)
+                 cold_fallback=False, mesh_label="off", knobs=None):
+        # the serving knobs live in a KnobSet read PER BATCH (not
+        # frozen constructor copies), so a runtime change — POST
+        # /v1/config or the closed-loop controller — lands on the very
+        # next batch.  Without an externally supplied KnobSet (or any
+        # runtime mutation of one), every read returns exactly the
+        # constructor values: today's static behavior, bit-for-bit.
+        if knobs is None:
+            from ..control import KnobSet
+
+            knobs = KnobSet(static={
+                "batch_window": float(batch_window),
+                "max_batch": int(max_batch),
+                "max_queue": int(max_queue),
+                "max_speculation": 0,
+            })
+        self.knobs = knobs
         self.stats = stats if stats is not None else ServiceStats()
         self.device_recovery = device_recovery
         # the serving mesh shape ("off" | "DPxSP") — stamped on every
@@ -1260,6 +1367,25 @@ class SuggestScheduler:
             target=self._loop, name="hyperopt-service-scheduler", daemon=True
         )
         self._thread.start()
+
+    # -- live knobs ------------------------------------------------------
+    # per-batch reads, NOT cached: the control plane's whole contract
+    # is that a knob change takes effect on the next batch
+    @property
+    def batch_window(self) -> float:
+        return self.knobs.get("batch_window")
+
+    @property
+    def max_batch(self) -> int:
+        return self.knobs.get("max_batch")
+
+    @property
+    def max_queue(self) -> int:
+        return self.knobs.get("max_queue")
+
+    @property
+    def max_speculation(self) -> int:
+        return self.knobs.get("max_speculation")
 
     # -- submission -----------------------------------------------------
     def submit(self, study: Study, n: int = 1, idempotency_key=None,
@@ -1327,6 +1453,12 @@ class SuggestScheduler:
                 with self._queue_cv:
                     self._busy = False
                     self._queue_cv.notify_all()
+                    depth = len(self._queue)
+                # dispatch-time sample: without it the depth gauge (and
+                # the control plane's mean-depth objective) only ever
+                # saw arrival instants — a quiet tenant's drained queue
+                # between arrivals was a blind spot
+                self.stats.set_queue_depth(depth)
 
     def _dispatch_batch(self, batch):
         try:
@@ -1664,8 +1796,15 @@ class SuggestScheduler:
         from ..algos import tpe_device
 
         key = tpe_device.program_key(flat_requests)
+        cap = self.max_speculation
         with self._bg_lock:
             if key in self._bg_compiling:
+                return
+            if cap and len(self._bg_compiling) >= cap:
+                # speculation-depth knob: bound the CONCURRENT
+                # background compiles (0 = unbounded, the historical
+                # behavior); an over-cap program simply stays cold
+                # until a slot frees — the next request re-requests it
                 return
             self._bg_compiling.add(key)
         clones = [
@@ -1755,7 +1894,9 @@ class OptimizationService:
                  compile_plane=True, mesh=None, replica_id=None,
                  advertise_url=None, replica_ttl=None,
                  takeover_prewarm=True, mirror_src_root=None,
-                 unsafe_shared_compile_cache=False):
+                 unsafe_shared_compile_cache=False,
+                 control_enabled=False, control_window_s=30.0,
+                 control_interval_s=0.0, control_seed=0):
         self.stats = ServiceStats()
         # mesh execution mode (--mesh auto|DPxSP|off): resolve the spec
         # ONCE — every study's fused prepare, the warmup replay, and
@@ -1975,15 +2116,71 @@ class OptimizationService:
                 slo_mod.DEFAULT_TICK_INTERVAL if slo_tick is None
                 else slo_tick
             )
+        # the live serving knobs: constructor args become the STATIC
+        # config (the controller's revert target and the provably-inert
+        # default); runtime changes arrive via POST /v1/config or the
+        # closed-loop controller.  Provenance journals under the root.
+        from ..control import (
+            Controller,
+            ControlStats,
+            KnobSet,
+            ObjectiveProbe,
+        )
+
+        control_dir = (
+            os.path.join(os.path.abspath(root), "control")
+            if root else None
+        )
+        self.knobs = KnobSet(
+            static={
+                "batch_window": float(batch_window),
+                "max_batch": int(max_batch),
+                "max_queue": int(max_queue),
+                "max_speculation": 0,
+            },
+            journal_path=(
+                os.path.join(control_dir, "knobs.jsonl")
+                if control_dir else None
+            ),
+        )
+        self.control_stats = ControlStats()
         self.scheduler = SuggestScheduler(
             stats=self.stats,
             device_recovery=self.device_recovery,
-            batch_window=batch_window,
-            max_batch=max_batch,
-            max_queue=max_queue,
             cold_fallback=cold_fallback,
             mesh_label=self.mesh_label,
+            knobs=self.knobs,
         )
+        # the self-tuning controller (--self-tune; default OFF — with
+        # control_enabled=False nothing below is constructed and the
+        # scheduler runs the static config forever)
+        self.control_enabled = bool(control_enabled)
+        self.controller = None
+        if self.control_enabled:
+            probe = ObjectiveProbe(
+                service_stats=self.stats,
+                device_stats=self.device_stats,
+                fault_stats=self.fault_stats,
+            )
+            self.controller = Controller(
+                knobs=self.knobs,
+                probe=probe,
+                rules=self.slo.rules,
+                seed=control_seed,
+                window_s=control_window_s,
+                interval_s=control_interval_s,
+                trials_dir=control_dir,
+                recorder=(
+                    self.flight_recorder if self.slo_enabled else None
+                ),
+                tracer=self.tracer,
+                stats=self.control_stats,
+                breach_fn=self._control_breach_view,
+            )
+            self.flight_recorder.set_provider(
+                "control", self.controller.recent_decisions
+            )
+            self.controller.start()
         self.suggest_timeout = float(suggest_timeout)
         # replica plane goes live LAST: the heartbeat advertises this
         # replica and the failure detector starts adopting dead
@@ -2311,7 +2508,7 @@ class OptimizationService:
     # -- API -----------------------------------------------------------
     def create_study(self, study_id, space, seed=0, algo="tpe",
                      algo_params=None, exist_ok=False,
-                     idempotency_key=None) -> dict:
+                     idempotency_key=None, early_stop=None) -> dict:
         with self._traced_request(
             "service.create_study", study=str(study_id)
         ) as (_trace, root):
@@ -2332,6 +2529,7 @@ class OptimizationService:
                     study = self.registry.create(
                         study_id, space, seed=seed, algo_name=algo,
                         algo_params=algo_params, exist_ok=exist_ok,
+                        early_stop=early_stop,
                     )
                 except BackpressureError:
                     # registry-full 429s must show on the same rejection
@@ -2363,7 +2561,8 @@ class OptimizationService:
                         )
                         return replay
                     if not study.config_matches(
-                        space, seed, algo, algo_params
+                        space, seed, algo, algo_params,
+                        early_stop=early_stop,
                     ):
                         raise
             with study.lock:
@@ -2395,6 +2594,14 @@ class OptimizationService:
         # requests untouched by compilation count as steady state.
         compiles_before = self.stats.n_compile_events
         study = self._study_for_request(study_id)
+        if study.stopped is not None:
+            # SH5xx-stopped: terminal for NEW work (reports for
+            # already-issued trials still land); resume_study reverses
+            raise StudyStopped(
+                f"study {study_id!r} was stopped by its early-stop "
+                f"hook ({study.stopped.get('rule')}); resume it to "
+                f"continue"
+            )
         with self._traced_request(
             "service.suggest", study=str(study_id), n=int(n)
         ) as (trace, root):
@@ -2513,13 +2720,56 @@ class OptimizationService:
                             tid, loss=loss, status=status, result=result,
                             idempotency_key=idempotency_key,
                         )
+                        # SH5xx actuation (per-study opt-in): evaluate
+                        # the stop criterion on every landed result —
+                        # the server-side call the hook never had
+                        stop_record = study.check_early_stop()
                 except OwnershipLost:
                     # stale-fenced terminal write, dropped before any
                     # journal/store mutation — redirect to the owner
                     self._relinquish_study(study_id)
                     raise self._not_owner(study_id)
+                if stop_record is not None:
+                    root.set_attr("early_stopped", stop_record["rule"])
+                    self._on_study_stopped(study, stop_record)
         self.stats.record_request("report")
         return {"tid": int(doc["tid"]), "state": doc["state"]}
+
+    def _on_study_stopped(self, study, record):
+        """Bookkeeping for one SH5xx admission reclaim: count it,
+        flight-record it, and log — the slot itself is already free
+        (the registry's capacity check skips stopped studies)."""
+        self.control_stats.record_reclaimed()
+        logger.info(
+            "early-stop actuation: study %r stopped (%s); admission "
+            "slot reclaimed", study.study_id, record["rule"],
+        )
+        if self.slo_enabled:
+            try:
+                self.flight_recorder.dump("control:study_stopped", {
+                    "study": study.study_id, "stop": record,
+                })
+            except Exception:  # pragma: no cover - defensive
+                logger.exception("stop-actuation flight dump failed")
+
+    def resume_study(self, study_id) -> dict:
+        """Reverse an SH5xx stop: re-admit the study (subject to the
+        same capacity check a create pays) and reset its stop
+        criterion.  The ``POST /v1/studies/<id>/resume`` handler."""
+        study = self._study_for_request(study_id)
+        with study.lock:
+            if study.stopped is not None:
+                if self.registry.n_active() >= self.registry.max_studies:
+                    self.stats.record_rejection("resume_study")
+                    raise BackpressureError(
+                        f"study registry full "
+                        f"({self.registry.max_studies}); cannot resume"
+                    )
+                study.resume()
+                self.control_stats.record_resumed()
+            out = study.status()
+        self.stats.record_request("resume_study")
+        return out
 
     def study_status(self, study_id) -> dict:
         study = self._study_for_request(study_id)
@@ -2558,6 +2808,16 @@ class OptimizationService:
             "flight_recorder": self.flight_recorder.summary(),
             "warmup": self.warmup.progress_brief(),
             "compile_ledger": self.compile_ledger.summary(),
+            "control": {
+                "enabled": self.control_enabled,
+                "knobs": self.knobs.values(),
+                "is_static": self.knobs.is_static,
+                "stats": self.control_stats.summary(),
+                "controller": (
+                    self.controller.status()
+                    if self.controller is not None else None
+                ),
+            },
             "replica": (
                 {
                     "replica_id": self.replica_set.replica_id,
@@ -2575,6 +2835,53 @@ class OptimizationService:
         recorder's state."""
         self.stats.record_request("alerts")
         return self.slo.alerts_payload()
+
+    # -- control plane ---------------------------------------------------
+    def _control_breach_view(self) -> dict:
+        """The controller's SL6xx safety view: cumulative breach
+        transitions (a delta across an observation window means a
+        breach FIRED during it → revert) plus the currently-breaching
+        rule ids (non-empty → hold, don't tune into an incident)."""
+        rows = self.slo.evaluate(force=True)
+        return {
+            "transitions": sum(
+                r.get("breaches_total", 0) for r in rows
+            ),
+            "breaching": [r["rule"] for r in rows if not r["ok"]],
+        }
+
+    def get_config(self) -> dict:
+        """The ``GET /v1/config`` document: knob specs + live/static
+        values, recent provenance, and the controller's state."""
+        self.stats.record_request("config")
+        out = self.knobs.describe()
+        out["provenance"] = self.knobs.provenance()[-32:]
+        out["control_enabled"] = self.control_enabled
+        out["controller"] = (
+            self.controller.status()
+            if self.controller is not None else None
+        )
+        out["stats"] = self.control_stats.control_metrics()
+        return out
+
+    def set_config(self, changes: dict, source="api") -> dict:
+        """Apply validated knob changes (the ``POST /v1/config``
+        body's ``knobs`` dict) — all-or-nothing, provenance-journaled.
+        ``{"revert": true}`` restores the static config instead."""
+        if not isinstance(changes, dict):
+            raise ValueError("body must be a JSON object")
+        knobs = changes.get("knobs")
+        if changes.get("revert"):
+            values = self.knobs.revert(source=str(source))
+        elif isinstance(knobs, dict) and knobs:
+            values = self.knobs.set_many(knobs, source=str(source))
+        else:
+            raise ValueError(
+                "body must carry a non-empty 'knobs' object or "
+                "'revert': true"
+            )
+        self.stats.record_request("config")
+        return {"values": values, "is_static": self.knobs.is_static}
 
     def _recent_chaos(self) -> list:
         monkey = _active_chaos()
@@ -2718,6 +3025,7 @@ class OptimizationService:
             study_health={"rows": rows, "truncated_total": truncated},
             store=self.store_stats,
             slo=self.slo.metrics_rows() if self.slo_enabled else None,
+            control=self.control_stats.control_metrics(),
             build=build_info(),
             extra=extra,
         )
@@ -2732,6 +3040,10 @@ class OptimizationService:
 
     def close(self, timeout=60.0):
         self._closed = True
+        if self.controller is not None:
+            # stop the tuner before the scheduler it tunes: a mid-close
+            # knob write against a draining queue is pure noise
+            self.controller.close()
         self.scheduler.close(timeout=timeout)
         if self.replica_set is not None:
             # graceful handover: release every held lease (fence

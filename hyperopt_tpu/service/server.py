@@ -77,6 +77,7 @@ from .core import (
     ServiceDraining,
     StudyExists,
     StudyNotFound,
+    StudyStopped,
     _active_chaos,
     canonical_json,
     decode_space,
@@ -142,6 +143,14 @@ class _Handler(BaseHTTPRequestHandler):
             headers=headers,
         )
 
+    def _is_loopback(self) -> bool:
+        """Authenticated-enough for knob writes: the TCP peer must be
+        the loopback interface.  Anything routed (including the pod
+        network) is refused — runtime reconfiguration is an operator
+        action taken ON the host, not a fleet API."""
+        host = self.client_address[0]
+        return host in ("127.0.0.1", "::1", "::ffff:127.0.0.1")
+
     def _endpoint_label(self) -> str:
         """Coarse endpoint label for the server-side error counter
         (the SL603 numerator)."""
@@ -188,6 +197,11 @@ class _Handler(BaseHTTPRequestHandler):
         except StudyNotFound as e:
             self._send_error_json(404, e)
         except StudyExists as e:
+            self._send_error_json(409, e)
+        except StudyStopped as e:
+            # terminal-but-reversible: the study's early-stop criterion
+            # fired; 409 (not 404) because the study still exists and a
+            # resume makes the same request valid again
             self._send_error_json(409, e)
         except TimeoutError as e:
             # a timed-out suggest is a failed request the SLO layer
@@ -267,6 +281,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, self.service.warmup_status())
             elif path == "/v1/replicas":
                 self._send(200, self.service.replica_status())
+            elif path == "/v1/config":
+                self._send(200, self.service.get_config())
             elif path == "/v1/studies":
                 self._send(200, {"studies": self.service.list_studies()})
             elif path.startswith("/v1/studies/"):
@@ -309,6 +325,7 @@ class _Handler(BaseHTTPRequestHandler):
                     algo=body.get("algo", "tpe"),
                     algo_params=body.get("algo_params") or None,
                     exist_ok=bool(body.get("exist_ok", False)),
+                    early_stop=body.get("early_stop") or None,
                     idempotency_key=idem,
                 )
                 if self._chaos_drop("create_study", idem or study_id, "post"):
@@ -342,6 +359,28 @@ class _Handler(BaseHTTPRequestHandler):
                 if self._chaos_drop("report", idem or study_id, "post"):
                     return
                 self._send(200, canonical_json(out))
+            elif path.startswith("/v1/studies/") and path.endswith("/resume"):
+                study_id = path[len("/v1/studies/"):-len("/resume")]
+                self._send(200, self.service.resume_study(study_id))
+            elif path == "/v1/config":
+                if not self._is_loopback():
+                    self._send(
+                        403,
+                        {
+                            "error": "Forbidden",
+                            "detail": "POST /v1/config is "
+                                      "localhost-only (operator knob "
+                                      "writes are not a fleet API)",
+                        },
+                    )
+                    return
+                self._send(
+                    200,
+                    self.service.set_config(
+                        body,
+                        source=f"api:{self.client_address[0]}",
+                    ),
+                )
             elif path == "/v1/shutdown":
                 self._send(200, {"ok": True, "draining": True})
                 # drain + stop off-thread: this handler must finish its

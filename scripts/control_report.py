@@ -1,0 +1,290 @@
+"""Proof artifact for the closed-loop control plane → CONTROL_SERVE.json.
+
+Two campaigns of the SAME seeded shifting-load profile
+(``scripts/serve_loadgen.py --profile``) against two servers:
+
+- **static** — the control plane off (``control_enabled=False``): the
+  scheduler reads the constructor knob values every batch, exactly the
+  pre-control service;
+- **self_tuned** — ``--self-tune`` on: the background controller runs
+  TPE over the serving knobs, scoring each configuration over one
+  objective window and reverting to static on any SL6xx breach.
+
+Gates (the exit code, and the ``gates`` block in the artifact):
+
+1. ``p99_no_worse`` — the self-tuned arm's warm suggest p99 is within
+   a platform-calibrated tolerance of the static arm's (the controller
+   must never cost the latency it exists to protect; warm-only because
+   cold compiles are attributed separately per the PR 7 convention);
+2. ``zero_breach_transitions`` — no SL6xx rule fired a breach
+   transition during the self-tuned campaign;
+3. ``decisions_journaled`` — every ``applied`` decision in the
+   controller's durable decision journal also appears in the
+   flight-recorder ring AND has a matching knob-provenance journal
+   entry (source ``controller``) — no unlogged actuation;
+4. ``controller_active`` — the loop actually ran (>= 1 decision);
+5. ``forced_breach_reverts`` — a deterministic fixture (injected
+   breach transition, fake probe) proves the controller reverts to the
+   static config within ONE observation window and freezes.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/control_report.py \
+        [--quick] [--seed 0] [--window 1.0] [--out CONTROL_SERVE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SCRIPTS = os.path.dirname(os.path.abspath(__file__))
+if _SCRIPTS not in sys.path:
+    sys.path.insert(0, _SCRIPTS)
+
+import serve_loadgen  # noqa: E402
+
+# warm-p99 no-worse tolerance by platform: CPU CI pays seconds-scale
+# fused-dispatch contention with run-to-run noise far beyond a TPU's
+# (same calibration precedent as the loadgen's SLO bounds), so the
+# gate is tight on the hardware that matters and honest about CI
+P99_TOLERANCE_FRAC = {"tpu": 0.10, "cpu": 0.50}
+
+
+def _slo_rules():
+    """Platform-calibrated SL6xx rules (the serve_loadgen convention:
+    deployment-config bounds, CPU-CI values wide enough that only real
+    pathology breaches)."""
+    from hyperopt_tpu import slo as slo_mod
+
+    tpu = serve_loadgen._platform() == "tpu"
+    return slo_mod.default_rules(
+        latency_ratio={"ratio_max": 25.0 if tpu else 100.0},
+        latency_absolute={"p99_bound_s": 2.5 if tpu else 10.0},
+    )
+
+
+def forced_breach_fixture(seed=0):
+    """Deterministic revert-within-one-window proof: a Controller with
+    a fake probe and an injected breach schedule — one clean evaluated
+    cycle, then a breach transition lands inside the second applied
+    window.  Asserts the SECOND cycle ends reverted-to-static +
+    frozen, i.e. the revert happened within that one window."""
+    from hyperopt_tpu.control import Controller, KnobSet
+    from hyperopt_tpu.control.objective import WindowResult
+
+    knobs = KnobSet(static={
+        "batch_window": 0.004, "max_batch": 8,
+        "max_queue": 1024, "max_speculation": 0,
+    })
+
+    class _FakeProbe:
+        def open(self):
+            return {"t": 0.0}
+
+        def close(self, opened):
+            return WindowResult(
+                ok=True, loss=0.1, warm_p99_s=0.1,
+                mean_queue_depth=0.0, duty_cycle=0.5,
+                warm_count=10, wall_s=0.1,
+            )
+
+    # breach_fn is consulted twice per cycle (before/after the window):
+    # schedule [0, 0] = clean cycle 1, [0, 1] = transition fires during
+    # cycle 2's window
+    schedule = iter([0, 0, 0, 1])
+
+    def breach_fn():
+        return {"transitions": next(schedule, 1), "breaching": []}
+
+    controller = Controller(
+        knobs, _FakeProbe(), seed=seed, window_s=0.0,
+        breach_fn=breach_fn,
+    )
+    out1 = controller.step()
+    knobs_moved = not knobs.is_static
+    out2 = controller.step()
+    reverted = knobs.is_static and controller.frozen
+    actions = [d["action"] for d in controller.recent_decisions()]
+    out3 = controller.step()  # frozen: no further actuation
+    return {
+        "cycle1": out1,
+        "knobs_moved_in_cycle1": knobs_moved,
+        "cycle2": out2,
+        "cycle3": out3,
+        "decision_actions": actions,
+        "windows_to_revert": 1,
+        "ok": (
+            out1 == "evaluated"
+            and knobs_moved
+            and out2 == "reverted"
+            and reverted
+            and out3 == "frozen"
+            and actions[-1] == "reverted"
+        ),
+    }
+
+
+def _audit_decisions(info):
+    """Gate 3: applied decisions ⊆ flight ring ∧ knob journal."""
+    decisions = info.get("decisions", [])
+    flight = info.get("flight", [])
+    journal = info.get("journal", [])
+    applied = [d for d in decisions if d["action"] == "applied"]
+    flight_seqs = {
+        d["seq"] for d in flight if d["action"] == "applied"
+    }
+    controller_writes = [
+        dict(r["changes"]) for r in journal
+        if r.get("source") == "controller"
+    ]
+    missing_flight = [
+        d["seq"] for d in applied if d["seq"] not in flight_seqs
+    ]
+    missing_journal = [
+        d["seq"] for d in applied
+        if dict(d["knobs"]) not in controller_writes
+    ]
+    return {
+        "n_applied": len(applied),
+        "n_controller_journal_writes": len(controller_writes),
+        "missing_from_flight_ring": missing_flight,
+        "missing_from_knob_journal": missing_journal,
+        "ok": not missing_flight and not missing_journal,
+    }
+
+
+def run_ab(profile=None, seed=0, window_s=1.0, batch_window=0.004):
+    """The static vs self-tuned A/B under the shifting profile."""
+    profile = profile or [dict(p) for p in serve_loadgen.DEFAULT_PROFILE]
+
+    static = serve_loadgen.run_profile(
+        profile=profile, seed=seed, batch_window=batch_window,
+        service_kwargs={"slo_rules": _slo_rules()},
+    )
+
+    tuned_info = {}
+
+    def grab(service):
+        tuned_info["decisions"] = (
+            service.controller.decision_log_records()
+        )
+        tuned_info["flight"] = service.controller.recent_decisions()
+        tuned_info["journal"] = service.knobs.journal_records()
+        tuned_info["status"] = service.controller.status()
+        rows = service.slo.evaluate(force=True)
+        tuned_info["breach_transitions"] = sum(
+            r.get("breaches_total", 0) for r in rows
+        )
+        tuned_info["breaching"] = [
+            r["rule"] for r in rows if not r["ok"]
+        ]
+
+    tuned_root = tempfile.mkdtemp(prefix="hyperopt-control-ab-")
+    tuned = serve_loadgen.run_profile(
+        profile=profile, seed=seed, batch_window=batch_window,
+        root=tuned_root, on_service=grab,
+        service_kwargs={
+            "slo_rules": _slo_rules(),
+            "control_enabled": True,
+            "control_window_s": window_s,
+            "control_interval_s": 0.0,
+            "control_seed": seed,
+        },
+    )
+
+    fixture = forced_breach_fixture(seed=seed)
+    audit = _audit_decisions(tuned_info)
+    platform = serve_loadgen._platform()
+    tol = P99_TOLERANCE_FRAC.get(platform, 0.50)
+    p99_static = static["suggest_warm_p99_ms"]
+    p99_tuned = tuned["suggest_warm_p99_ms"]
+    p99_ok = (
+        p99_static is not None and p99_tuned is not None
+        and p99_tuned <= p99_static * (1.0 + tol)
+    )
+    status = tuned_info.get("status", {})
+    gates = {
+        "p99_no_worse": bool(p99_ok),
+        "zero_breach_transitions": (
+            tuned_info.get("breach_transitions", 0) == 0
+        ),
+        "decisions_journaled": audit["ok"],
+        "controller_active": status.get("n_decisions", 0) >= 1,
+        "forced_breach_reverts": fixture["ok"],
+        "both_campaigns_complete": bool(static["ok"] and tuned["ok"]),
+    }
+    return {
+        "metric": "control_serve_ab",
+        "ok": all(gates.values()),
+        "gates": gates,
+        "platform": platform,
+        "seed": seed,
+        "control_window_s": window_s,
+        "p99_tolerance_frac": tol,
+        "profile": profile,
+        "static": {
+            "ok": static["ok"],
+            "suggest_warm_p50_ms": static["suggest_warm_p50_ms"],
+            "suggest_warm_p99_ms": p99_static,
+            "queue_depth_mean": static["queue_depth_mean"],
+            "wall_s": static["wall_s"],
+        },
+        "self_tuned": {
+            "ok": tuned["ok"],
+            "suggest_warm_p50_ms": tuned["suggest_warm_p50_ms"],
+            "suggest_warm_p99_ms": p99_tuned,
+            "queue_depth_mean": tuned["queue_depth_mean"],
+            "wall_s": tuned["wall_s"],
+            "breach_transitions": tuned_info.get("breach_transitions"),
+            "breaching": tuned_info.get("breaching"),
+            "controller": status,
+            "decision_actions": [
+                d["action"] for d in tuned_info.get("decisions", [])
+            ],
+        },
+        "decision_audit": audit,
+        "forced_breach": fixture,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--window", type=float, default=1.0,
+                    help="controller observation window (seconds)")
+    ap.add_argument("--batch-window", type=float, default=0.004,
+                    dest="batch_window")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI smoke config (short phases, 0.5s window)")
+    ap.add_argument(
+        "--out",
+        default=os.path.join(
+            os.path.dirname(_SCRIPTS), "CONTROL_SERVE.json"
+        ),
+    )
+    options = ap.parse_args(argv)
+    profile = [dict(p) for p in serve_loadgen.DEFAULT_PROFILE]
+    window_s = options.window
+    if options.quick:
+        for p in profile:
+            p["trials"] = min(int(p["trials"]), 4)
+        window_s = min(window_s, 0.5)
+    report = run_ab(
+        profile=profile, seed=options.seed, window_s=window_s,
+        batch_window=options.batch_window,
+    )
+    print(json.dumps(report, indent=1))
+    if options.out:
+        with open(options.out, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -222,6 +222,151 @@ def _platform():
     return jax.devices()[0].platform
 
 
+# the default shifting-load profile: a calm warm-up, a surge at 4x the
+# concurrency with zero think time, then a taper.  Declarative and
+# seeded — the same (profile, seed) pair replays the same request
+# schedule, which is what makes the control_report A/B an A/B.
+DEFAULT_PROFILE = (
+    {"name": "calm", "studies": 2, "trials": 10, "think_s": 0.004},
+    {"name": "surge", "studies": 8, "trials": 10, "think_s": 0.0},
+    {"name": "taper", "studies": 3, "trials": 10, "think_s": 0.002},
+)
+
+
+def load_profile(spec):
+    """Resolve a ``--profile`` operand: ``default`` (or empty) for
+    :data:`DEFAULT_PROFILE`, an inline JSON array, or a path to a JSON
+    file holding one."""
+    if not spec or spec == "default":
+        return [dict(p) for p in DEFAULT_PROFILE]
+    if spec.lstrip().startswith("["):
+        return json.loads(spec)
+    with open(spec) as f:
+        return json.load(f)
+
+
+def run_profile(profile=None, seed=0, batch_window=0.004, root=None,
+                tracer=None, service_kwargs=None, on_service=None):
+    """The shifting-load campaign: run each profile phase's study
+    cohort to completion in sequence against ONE server, so the
+    arrival rate and concurrency move under the scheduler's feet.
+    Each phase is ``{"name", "studies", "trials", "think_s"}`` —
+    declarative and fully seeded.  Returns the campaign payload
+    (per-phase walls + the same latency headlines as the steady
+    loadgen); ``scripts/control_report.py`` replays the identical
+    schedule against a static and a self-tuned server."""
+    from hyperopt_tpu.fmin import space_eval
+    from hyperopt_tpu.service import (
+        OptimizationService,
+        ServiceClient,
+        ServiceServer,
+    )
+
+    phases = []
+    for i, p in enumerate(profile or DEFAULT_PROFILE):
+        p = dict(p)
+        unknown = set(p) - {"name", "studies", "trials", "think_s"}
+        if unknown:
+            raise ValueError(
+                f"profile phase {i}: unknown keys {sorted(unknown)}"
+            )
+        p.setdefault("name", f"phase{i}")
+        p["studies"] = int(p.get("studies", 4))
+        p["trials"] = int(p.get("trials", 10))
+        p["think_s"] = float(p.get("think_s", 0.0))
+        phases.append(p)
+
+    space = _space()
+    service = OptimizationService(
+        root=root, batch_window=batch_window, tracer=tracer,
+        **dict(service_kwargs or {}),
+    )
+    server = ServiceServer(service).start()
+    errors = []
+    phase_rows = []
+    t0 = time.perf_counter()
+    try:
+        for pi, ph in enumerate(phases):
+            pt0 = time.perf_counter()
+
+            def drive(i, ph=ph, pi=pi):
+                try:
+                    sid = f"{ph['name']}-{i}"
+                    client = ServiceClient(server.url)
+                    client.create_study(
+                        sid, space, seed=seed * 10000 + pi * 100 + i,
+                        algo="tpe", algo_params=ALGO_PARAMS,
+                    )
+                    rng = np.random.default_rng(
+                        seed * 10000 + pi * 100 + i
+                    )
+                    for _ in range(ph["trials"]):
+                        (t,) = client.suggest(sid)
+                        point = space_eval(space, t["vals"])
+                        client.report(
+                            sid, t["tid"], loss=_objective(point, rng)
+                        )
+                        if ph["think_s"]:
+                            time.sleep(ph["think_s"])
+                except Exception as e:
+                    errors.append(f"{ph['name']} study {i}: {e!r}")
+
+            threads = [
+                threading.Thread(target=drive, args=(i,), daemon=True)
+                for i in range(ph["studies"])
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            if any(t.is_alive() for t in threads):
+                errors.append(f"phase {ph['name']}: clients timed out")
+            phase_rows.append(
+                {**ph, "wall_s": round(time.perf_counter() - pt0, 3)}
+            )
+        wall_s = time.perf_counter() - t0
+        stats = service.stats.summary()
+        exact = service.stats.window_quantiles()
+        completed = {
+            sid: service.study_status(sid)["n_completed"]
+            for sid in service.list_studies()
+        }
+        if on_service is not None:
+            on_service(service)
+    finally:
+        server.stop()
+
+    expected = {
+        f"{p['name']}-{i}": p["trials"]
+        for p in phases for i in range(p["studies"])
+    }
+    ok = not errors and all(
+        completed.get(s) == n for s, n in expected.items()
+    )
+    return {
+        "metric": "serve_profile",
+        "ok": ok,
+        "errors": errors,
+        "seed": seed,
+        "batch_window_s": batch_window,
+        "phases": phase_rows,
+        "total_suggest_requests": sum(expected.values()),
+        "suggest_p50_ms": stats["suggest_latency"]["p50_ms"],
+        "suggest_p99_ms": stats["suggest_latency"]["p99_ms"],
+        "suggest_p50_exact_ms": exact["p50_ms"],
+        "suggest_p99_exact_ms": exact["p99_ms"],
+        "suggest_warm_p50_ms": stats["suggest_latency_warm"]["p50_ms"],
+        "suggest_warm_p99_ms": stats["suggest_latency_warm"]["p99_ms"],
+        "n_warm_suggests": stats["suggest_latency_warm"]["count"],
+        "queue_depth_mean": stats.get("queue_depth_mean"),
+        "mean_batch_occupancy": stats["mean_batch_occupancy"],
+        "n_dispatches": stats["n_dispatches"],
+        "completed_per_study": completed,
+        "wall_s": round(wall_s, 3),
+        "platform": _platform(),
+    }
+
+
 def run_traced(n_studies, n_trials, seed, batch_window, trace_sample,
                trace_slow_ms=None, trace_log=None, overhead_check=False,
                min_coverage=0.9):
@@ -307,6 +452,13 @@ def main(argv=None):
     ap.add_argument("--quick", action="store_true",
                     help="CI smoke config (8 studies x 8 trials)")
     ap.add_argument(
+        "--profile", nargs="?", const="default", default=None,
+        help="shifting-load mode: run a piecewise seeded phase "
+             "schedule ('default', an inline JSON array, or a path "
+             "to a JSON file of {name, studies, trials, think_s} "
+             "phases) instead of the steady campaign",
+    )
+    ap.add_argument(
         "--out",
         default=os.path.join(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -342,6 +494,20 @@ def main(argv=None):
     )
     options = ap.parse_args(argv)
     n_trials = 8 if options.quick else options.trials
+    if options.profile is not None:
+        profile = load_profile(options.profile)
+        if options.quick:
+            for p in profile:
+                p["trials"] = min(int(p.get("trials", 10)), 4)
+        report = run_profile(
+            profile=profile, seed=options.seed,
+            batch_window=options.batch_window,
+        )
+        print(json.dumps(report, indent=1))
+        # the shifting-load payload is a different metric: never
+        # clobber the committed steady-state BENCH_SERVE.json unless
+        # the caller pointed --out somewhere on purpose
+        return 0 if report["ok"] else 1
     if options.trace:
         report, trep = run_traced(
             n_studies=options.studies,

@@ -734,3 +734,68 @@ def test_store_artifact_proves_the_segment_log_wins():
         # the doc arm's delta refresh is the O(N) rescan the segment
         # path exists to dodge
         assert doc["delta_refresh"]["scan_entries"] >= n
+
+
+# ---------------------------------------------------------------------------
+# CONTROL_SERVE.json — the PR 19 closed-loop control-plane artifact
+# ---------------------------------------------------------------------------
+
+CONTROL_SERVE = os.path.join(ROOT, "CONTROL_SERVE.json")
+
+
+@pytest.mark.skipif(
+    not os.path.exists(CONTROL_SERVE),
+    reason="no committed control artifact",
+)
+def test_control_serve_artifact_proves_the_closed_loop():
+    """The PR 19 acceptance artifact: the SAME seeded shifting-load
+    profile against a static server and a --self-tune server, with
+    every gate green — self-tuned warm p99 no worse (within the
+    platform tolerance recorded in the artifact), ZERO SL6xx breach
+    transitions in the self-tuned arm, every applied decision present
+    in both the decision journal and the knob-provenance journal, and
+    the forced-breach fixture reverting to static within one window.
+    Every guard is STRUCTURAL (gate booleans, counts, coverage) —
+    never absolute milliseconds: sandbox latency swings ~30x between
+    sessions."""
+    d = _load(CONTROL_SERVE)
+    assert d["metric"] == "control_serve_ab"
+    assert d["ok"] is True
+    gates = d["gates"]
+    assert gates["p99_no_worse"] is True
+    assert gates["zero_breach_transitions"] is True
+    assert gates["decisions_journaled"] is True
+    assert gates["controller_active"] is True
+    assert gates["forced_breach_reverts"] is True
+    assert gates["both_campaigns_complete"] is True
+    # both arms ran the same declared multi-phase profile to completion
+    assert len(d["profile"]) >= 2
+    assert d["static"]["ok"] is True and d["self_tuned"]["ok"] is True
+    # the controller actually closed the loop: proposals were applied,
+    # and every applied decision is journal-accounted (no unlogged
+    # actuation) — counts, not latencies
+    audit = d["decision_audit"]
+    assert audit["n_applied"] >= 1
+    assert audit["missing_from_flight_ring"] == []
+    assert audit["missing_from_knob_journal"] == []
+    assert audit["n_controller_journal_writes"] >= audit["n_applied"]
+    # zero breach transitions is recorded as a count, and the breaching
+    # set at campaign end is empty
+    assert d["self_tuned"]["breach_transitions"] == 0
+    assert d["self_tuned"]["breaching"] == []
+    # the forced-breach fixture: one clean evaluated cycle, then the
+    # injected transition reverts within ONE window and freezes
+    fb = d["forced_breach"]
+    assert fb["cycle1"] == "evaluated"
+    assert fb["knobs_moved_in_cycle1"] is True
+    assert fb["cycle2"] == "reverted"
+    assert fb["cycle3"] == "frozen"
+    assert fb["windows_to_revert"] == 1
+    assert fb["decision_actions"][-1] == "reverted"
+    # p99 comparison is a ratio bound the artifact itself declares —
+    # the guard checks consistency, never an absolute number
+    tol = d["p99_tolerance_frac"]
+    assert 0 < tol <= 0.5
+    assert d["self_tuned"]["suggest_warm_p99_ms"] <= (
+        d["static"]["suggest_warm_p99_ms"] * (1.0 + tol)
+    )

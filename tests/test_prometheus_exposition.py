@@ -12,6 +12,7 @@ discipline fails here, not in a scrape.
 import math
 import re
 
+from hyperopt_tpu.control import ControlStats
 from hyperopt_tpu.observability import (
     DeviceStats,
     FaultStats,
@@ -97,10 +98,19 @@ def _full_exposition():
         {"rule": "SL605", "status": "breach", "burn_fast": 2.0,
          "burn_slow": None, "breaches_total": 3},
     ]
+    control = ControlStats()
+    for outcome in ("proposed", "applied", "evaluated", "discarded",
+                    "reverted"):
+        control.record_decision(outcome)
+    control.set_objective(0.125)
+    control.set_frozen(True)
+    control.record_reclaimed()
+    control.record_resumed()
     return render_prometheus(
         timings=timings, speculation=spec, faults=faults,
         service=service, device=device, study_health=study_health,
-        store=store, slo=slo_rows, build=build_info(),
+        store=store, slo=slo_rows,
+        control=control.control_metrics(), build=build_info(),
         extra={"service_uptime_seconds": 12.5},
     )
 
@@ -239,6 +249,13 @@ class TestExpositionFormat:
             "hyperopt_slo_status",
             "hyperopt_slo_burn_rate",
             "hyperopt_slo_breaches_total",
+            # control plane (new)
+            "hyperopt_control_decisions_total",
+            "hyperopt_control_objective",
+            "hyperopt_control_frozen",
+            "hyperopt_control_freezes_total",
+            "hyperopt_control_reclaimed_studies_total",
+            "hyperopt_control_resumed_studies_total",
             # identity (new)
             "hyperopt_build_info",
         }
@@ -312,6 +329,32 @@ class TestExpositionFormat:
         keys = dict(labels)
         assert set(keys) == {"version", "jax", "backend"}
         assert float(value) == 1.0
+
+    def test_control_families_populated(self):
+        families = parse_exposition(_full_exposition())
+        outcomes = {
+            dict(labels)["outcome"]
+            for _, labels, _ in families[
+                "hyperopt_control_decisions_total"
+            ]["samples"]
+        }
+        assert {"applied", "evaluated", "reverted"} <= outcomes
+        ((_, _, frozen),) = families["hyperopt_control_frozen"][
+            "samples"
+        ]
+        assert float(frozen) == 1.0
+        ((_, _, obj),) = families["hyperopt_control_objective"][
+            "samples"
+        ]
+        assert float(obj) == 0.125
+        ((_, _, reclaimed),) = families[
+            "hyperopt_control_reclaimed_studies_total"
+        ]["samples"]
+        assert float(reclaimed) == 1.0
+        ((_, _, resumed),) = families[
+            "hyperopt_control_resumed_studies_total"
+        ]["samples"]
+        assert float(resumed) == 1.0
 
     def test_nan_renders_as_NaN_token(self):
         families = parse_exposition(_full_exposition())
